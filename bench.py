@@ -1,0 +1,113 @@
+"""Benchmark harness — prints ONE JSON line with the north-star metric:
+
+    cell-updates/sec = turns/s × H × W on 512×512, alive-count parity
+    vs the golden fixtures (BASELINE.json).
+
+Baseline: the reference publishes no numbers (BASELINE.md) and Go is not
+available in this image to measure its 4-node broker/worker stack, so the
+baseline is a documented engineering estimate of that system's ceiling:
+every turn ships the full 512² board through the broker twice, gob-encoded
+over net/rpc (`Server/gol/distributor.go:104-129` — ≈0.5 MB/turn plus 4
+round trips), on top of a branchy scalar Go kernel
+(`SubServer/distributor.go:119-208`). On the coursework's 4×t2 AWS nodes
+that bounds it to ~100 turns/s on 512², i.e. ~2.6e7 cell-updates/s. We use
+BASELINE_CUPS = 2.6e7; `vs_baseline` = measured / baseline.
+
+Usage: python bench.py [--size 512] [--turns 2000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BASELINE_CUPS = 2.6e7  # see module docstring
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=512)
+    ap.add_argument("--turns", type=int, default=2000)
+    ap.add_argument("--warmup-turns", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+
+    from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.ops.stencil import from_pixels
+    from gol_tpu.parallel.halo import shard_board, sharded_run_turns
+    from gol_tpu.parallel.mesh import make_mesh, resolve_shard_count
+
+    n = args.size
+    try:
+        world = read_pgm(f"images/{n}x{n}.pgm")
+    except (FileNotFoundError, ValueError):
+        rng = np.random.default_rng(0)
+        world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
+
+    n_shards = resolve_shard_count(n, len(jax.devices()))
+    mesh = make_mesh(n_shards)
+    cells = shard_board(from_pixels(world), mesh)
+
+    # correctness gate: alive-count parity vs golden CSV at turn 100
+    parity = None
+    if n == 512:
+        try:
+            import csv
+
+            with open("check/alive/512x512.csv") as f:
+                golden = {
+                    int(r["completed_turns"]): int(r["alive_cells"])
+                    for r in csv.DictReader(f)
+                }
+            at100 = sharded_run_turns(cells, 100, mesh)
+            got = int(np.asarray(at100).sum())
+            parity = got == golden[100]
+            if not parity:
+                print(
+                    f"PARITY FAIL: turn-100 alive {got} != {golden[100]}",
+                    file=sys.stderr,
+                )
+        except FileNotFoundError:
+            parity = None
+
+    # warmup: compile the timed loop length + smaller chunk
+    sharded_run_turns(cells, args.warmup_turns, mesh).block_until_ready()
+    sharded_run_turns(cells, args.turns, mesh).block_until_ready()
+
+    t0 = time.perf_counter()
+    out = sharded_run_turns(cells, args.turns, mesh)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    cups = args.turns * n * n / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "cell-updates/sec (512x512 torus)",
+                "value": round(cups, 1),
+                "unit": "cell-updates/s",
+                "vs_baseline": round(cups / BASELINE_CUPS, 2),
+                "detail": {
+                    "size": n,
+                    "turns": args.turns,
+                    "elapsed_s": round(elapsed, 4),
+                    "turns_per_s": round(args.turns / elapsed, 1),
+                    "devices": len(jax.devices()),
+                    "shards": n_shards,
+                    "alive_parity_turn100": parity,
+                    "baseline_cups_estimate": BASELINE_CUPS,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""gol_tpu — a TPU-native distributed Conway's Game of Life framework.
+
+A ground-up JAX/XLA/pallas re-design of the capability contract of
+joyce-leesw/Conway-s-GOL-Distributed (a Go net/rpc broker/worker system,
+see /root/reference). The Go system's row-strip goroutine fan-out and
+per-turn RPC board gather are replaced by a jit-compiled stencil sharded
+over a `jax.sharding.Mesh` with `lax.ppermute` halo exchange and `psum`
+reductions; the controller/broker control protocol (run / poll / snapshot /
+flag / kill, reference `Server/gol/distributor.go:54-83`) is kept
+semantically intact over a thin TCP control plane.
+
+Public surface (mirrors reference `Local/gol/gol.go:4-12`):
+
+    from gol_tpu import Params, run
+    run(Params(threads=8, image_width=512, image_height=512, turns=100),
+        events, key_presses)
+"""
+
+from gol_tpu.params import Params
+from gol_tpu.events import (
+    AliveCellsCount,
+    CellFlipped,
+    CellsFlipped,
+    Event,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+from gol_tpu.gol import run
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Params",
+    "run",
+    "Event",
+    "AliveCellsCount",
+    "CellFlipped",
+    "CellsFlipped",
+    "FinalTurnComplete",
+    "ImageOutputComplete",
+    "State",
+    "StateChange",
+    "TurnComplete",
+]

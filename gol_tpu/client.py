@@ -1,0 +1,87 @@
+"""Remote engine client — the controller side of the control plane.
+
+Duck-typed to `Engine` (same 5 methods), so the distributor is agnostic to
+in-process vs remote engines. Counterpart of the reference controller's
+`rpc.DialHTTP` + `client.Call` usage (`Local/gol/distributor.go:94,182`):
+one TCP connection per call; `server_distributor` blocks on its connection
+for the whole run exactly like the Go blocking `API.ServerDistributor` call.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from gol_tpu.engine import EngineKilled
+from gol_tpu.params import Params
+from gol_tpu.wire import recv_msg, send_msg
+
+
+class RemoteEngine:
+    def __init__(self, address: str, timeout: float = 10.0) -> None:
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "localhost", int(port))
+        self._timeout = timeout
+
+    def _call(self, header: dict, world=None, timeout=None):
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        try:
+            sock.settimeout(timeout)  # None → block (long-running run call)
+            send_msg(sock, header, world)
+            resp, resp_world = recv_msg(sock)
+        finally:
+            sock.close()
+        if not resp.get("ok"):
+            err = resp.get("error", "unknown engine error")
+            if err.startswith("killed:"):
+                raise EngineKilled(err)
+            raise RuntimeError(f"engine error: {err}")
+        return resp, resp_world
+
+    # --- Engine interface -------------------------------------------------
+
+    def server_distributor(
+        self,
+        params: Params,
+        world: np.ndarray,
+        sub_workers: Sequence[str] = (),
+        start_turn: int = 0,
+    ) -> Tuple[np.ndarray, int]:
+        resp, out = self._call(
+            {
+                "method": "ServerDistributor",
+                "params": {
+                    "threads": params.threads,
+                    "image_width": params.image_width,
+                    "image_height": params.image_height,
+                    "turns": params.turns,
+                },
+                "sub_workers": list(sub_workers),
+                "start_turn": start_turn,
+            },
+            world,
+            timeout=None,
+        )
+        return out, int(resp["turn"])
+
+    def alive_count(self) -> Tuple[int, int]:
+        resp, _ = self._call({"method": "Alivecount"},
+                             timeout=self._timeout)
+        return int(resp["alive"]), int(resp["turn"])
+
+    def get_world(self) -> Tuple[np.ndarray, int]:
+        resp, world = self._call({"method": "GetWorld"},
+                                 timeout=self._timeout)
+        return world, int(resp["turn"])
+
+    def cf_put(self, flag: int) -> None:
+        self._call({"method": "CFput", "flag": int(flag)},
+                   timeout=self._timeout)
+
+    def drain_flags(self) -> None:
+        self._call({"method": "DrainFlags"}, timeout=self._timeout)
+
+    def kill_prog(self) -> None:
+        self._call({"method": "KillProg"}, timeout=self._timeout)

@@ -1,0 +1,218 @@
+"""The controller distributor: orchestrates one full run.
+
+Re-design of reference `Local/gol/distributor.go:55-226`. Same observable
+contract — load `images/WxH.pgm`, drive the engine, emit the event stream,
+honour s/p/q/k keypresses, tick alive counts every 2 s, write
+`out/WxHxT.pgm`, support detach (`q`) / reattach (`CONT=yes`) — but the
+engine link is either an in-process `Engine` (default; the "no real
+cluster" test story) or a remote engine over the TCP control plane when
+`SER` is set, mirroring the reference env config
+(`Local/gol/distributor.go:90-105`).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from gol_tpu import events as ev
+from gol_tpu.engine import (
+    Engine,
+    EngineKilled,
+    FLAG_KILL,
+    FLAG_PAUSE,
+    FLAG_QUIT,
+)
+from gol_tpu.io.pgm import input_path, output_path, read_pgm, write_pgm
+from gol_tpu.params import Params
+from gol_tpu.utils.cell import alive_cells_from_board
+
+ALIVE_POLL_SECONDS = 2.0  # reference ticker (`Local/gol/distributor.go:58`)
+
+# Process-local default engine. A module global on purpose: it outlives
+# individual `run` calls, which is what makes in-process detach/reattach
+# (`q` then `CONT=yes`) work — the reference equivalent is the broker
+# process staying up holding `world`/`turn` (`Server:29-30`).
+_default_engine: Optional[Engine] = None
+_default_engine_lock = threading.Lock()
+
+
+def _resolve_engine():
+    ser = os.environ.get("SER", "")
+    if ser:
+        from gol_tpu.client import RemoteEngine
+
+        return RemoteEngine(ser)
+    global _default_engine
+    with _default_engine_lock:
+        if _default_engine is None or _default_engine._killed:
+            _default_engine = Engine()
+        return _default_engine
+
+
+def _sub_workers() -> List[str]:
+    """Worker list from SUB (comma separated); its *length* is the shard
+    count request (`Local/gol/distributor.go:100-105` defaults to four
+    workers on ports 8030-8060)."""
+    sub = os.environ.get("SUB", "")
+    if not sub:
+        return []
+    return [a for a in sub.split(",") if a]
+
+
+def distributor(
+    p: Params,
+    events_q: "queue.Queue",
+    key_presses: Optional["queue.Queue"] = None,
+    engine=None,
+    images_dir: Optional[str] = None,
+    out_dir: Optional[str] = None,
+    live_view: bool = False,
+) -> None:
+    images_dir = images_dir or os.environ.get("GOL_IMAGES", "images")
+    out_dir = out_dir or os.environ.get("GOL_OUT", "out")
+    engine = engine if engine is not None else _resolve_engine()
+
+    width, height = p.image_width, p.image_height
+    done = threading.Event()
+    kp_state = {"k": False}
+
+    # Attach: discard control flags left by a previous controller session
+    # BEFORE this session's keypress thread starts posting its own.
+    try:
+        engine.drain_flags()
+    except (EngineKilled, ConnectionError, OSError, AttributeError):
+        pass
+
+    # -- keypress goroutine (`Local/gol/distributor.go:107-152`) ----------
+    def keypress_loop() -> None:
+        paused = False
+        while not done.is_set():
+            try:
+                key = key_presses.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                if key == "s":
+                    world, turn = engine.get_world()
+                    fname = output_path(width, height, turn, out_dir)
+                    write_pgm(fname, world)
+                    events_q.put(
+                        ev.ImageOutputComplete(turn, os.path.basename(fname))
+                    )
+                elif key == "p":
+                    engine.cf_put(FLAG_PAUSE)
+                    _, turn = engine.alive_count()
+                    paused = not paused
+                    if paused:
+                        events_q.put(ev.StateChange(turn, ev.State.PAUSED))
+                    else:
+                        print("Continuing")
+                        events_q.put(
+                            ev.StateChange(turn, ev.State.EXECUTING)
+                        )
+                elif key == "q":
+                    engine.cf_put(FLAG_QUIT)
+                elif key == "k":
+                    kp_state["k"] = True
+                    engine.cf_put(FLAG_KILL)
+            except (EngineKilled, ConnectionError, OSError):
+                return
+            except RuntimeError:
+                # Transient engine state (e.g. snapshot requested before the
+                # board is loaded) — drop this keypress, keep serving.
+                continue
+
+    # -- 2 s alive ticker (`Local/gol/distributor.go:154-167`) ------------
+    def ticker_loop() -> None:
+        while not done.wait(ALIVE_POLL_SECONDS):
+            try:
+                alive, turn = engine.alive_count()
+            except (EngineKilled, ConnectionError, OSError):
+                return
+            events_q.put(ev.AliveCellsCount(turn, alive))
+
+    # -- live view feed: CellsFlipped diffs + TurnComplete ----------------
+    # The reference defines CellFlipped/TurnComplete but never emits them
+    # (`Local/gol/event.go:92-110`, SURVEY §7 step 5); here the live view
+    # polls board snapshots at frame rate and emits batched diffs, so view
+    # cost is one device→host transfer per rendered frame, not per cell.
+    def live_loop() -> None:
+        prev = None
+        prev_turn = -1
+        while not done.wait(0.1):
+            try:
+                world, turn = engine.get_world()
+            except (EngineKilled, ConnectionError, OSError, RuntimeError):
+                continue
+            if turn == prev_turn:
+                continue
+            cur = world != 0
+            if prev is None:
+                ys, xs = np.nonzero(cur)
+            else:
+                ys, xs = np.nonzero(cur != prev)
+            if len(xs):
+                events_q.put(ev.CellsFlipped(
+                    turn, tuple(zip(xs.tolist(), ys.tolist()))
+                ))
+            events_q.put(ev.TurnComplete(turn))
+            prev, prev_turn = cur, turn
+
+    if key_presses is not None:
+        threading.Thread(target=keypress_loop, daemon=True).start()
+    threading.Thread(target=ticker_loop, daemon=True).start()
+    if live_view:
+        threading.Thread(target=live_loop, daemon=True).start()
+
+    try:
+        # -- board source: fresh from PGM, or reattach (`:171-178`) -------
+        start_turn = 0
+        if os.environ.get("CONT", "") == "yes":
+            world, start_turn = engine.get_world()
+            turns_left = max(p.turns - start_turn, 0)
+        else:
+            world = read_pgm(input_path(width, height, images_dir))
+            turns_left = p.turns
+
+        events_q.put(ev.StateChange(start_turn, ev.State.EXECUTING))
+
+        # -- blocking run (`:182`) ----------------------------------------
+        run_params = Params(
+            threads=p.threads,
+            image_width=width,
+            image_height=height,
+            turns=turns_left,
+        )
+        try:
+            final_world, final_turn = engine.server_distributor(
+                run_params, world, _sub_workers(), start_turn=start_turn
+            )
+        except EngineKilled:
+            final_world, final_turn = world, start_turn
+
+        # -- finalize (`:187-226`) ----------------------------------------
+        alive_cells = alive_cells_from_board(final_world)
+        events_q.put(
+            ev.FinalTurnComplete(
+                final_turn, tuple((c.x, c.y) for c in alive_cells)
+            )
+        )
+        fname = output_path(width, height, final_turn, out_dir)
+        write_pgm(fname, final_world)
+        events_q.put(
+            ev.ImageOutputComplete(final_turn, os.path.basename(fname))
+        )
+        if kp_state["k"]:
+            try:
+                engine.kill_prog()
+            except (EngineKilled, ConnectionError, OSError):
+                pass
+        events_q.put(ev.StateChange(final_turn, ev.State.QUITTING))
+    finally:
+        done.set()
+        events_q.put(ev.CLOSE)

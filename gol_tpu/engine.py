@@ -1,0 +1,247 @@
+"""The GoL engine: broker + workers of the reference collapsed into one
+device-resident TPU service.
+
+The reference splits evolution across a broker turn loop
+(`Server/gol/distributor.go:104-165`) and RPC workers
+(`SubServer/distributor.go:48-82`), moving the whole board over the network
+twice per turn. Here the board is a row-sharded device array that never
+leaves the chips during a run: turns advance in compiled multi-turn chunks
+(`lax.scan` inside `shard_map`), and the host thread only wakes between
+chunks to honour the control protocol.
+
+Control protocol parity (`Server/gol/distributor.go:54-83`):
+
+    server_distributor  — blocking run               (API.ServerDistributor)
+    alive_count         — (alive, turn) poll         (API.Alivecount)
+    get_world           — board snapshot + turn      (API.GetWorld)
+    cf_put              — control flag               (API.CFput)
+    kill_prog           — die                        (API.KillProg)
+
+Flag values keep the reference wire encoding (`Cf.Flag`,
+`Server/gol/distributor.go:136-164`): 0 pause-toggle, 2 quit-run,
+5 kill-cluster. The broker-internal sentinel 4 ("no keypress this turn") is
+an artifact of reusing one Go channel as both mailbox and default-case and
+has no counterpart here — an empty thread-safe queue already means "no
+flag".
+
+Chunking policy (SURVEY §7 hard parts 1-2): chunk sizes are powers of two
+(bounded set of compiled programs), adapted so one chunk costs roughly
+CHUNK_TARGET_SECONDS of wall clock — large enough for near-asymptotic
+throughput, small enough that pause/quit/snapshot and the 2 s telemetry
+ticker stay responsive. (alive, turn) pairs are only ever published at chunk
+boundaries, so every published count is exact for its turn, matching the
+reference's mutex-coherent pair (`Server:131-134,173-183`).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+from gol_tpu.ops.stencil import alive_count_exact, from_pixels, to_pixels
+from gol_tpu.params import Params
+from gol_tpu.parallel.halo import shard_board, sharded_run_turns
+from gol_tpu.parallel.mesh import make_mesh, resolve_shard_count
+
+# Control-flag wire values (reference Cf.Flag).
+FLAG_PAUSE = 0
+FLAG_QUIT = 2
+FLAG_KILL = 5
+
+CHUNK_TARGET_SECONDS = 0.15
+MAX_CHUNK = 1024
+
+
+class EngineKilled(RuntimeError):
+    """Raised on any call after kill_prog — the in-process stand-in for the
+    reference worker's os.Exit(0) (`SubServer/distributor.go:42-45`)."""
+
+
+def _next_chunk(chunk: int, remaining: int) -> int:
+    """Largest power of two ≤ min(chunk, remaining). Keeping every compiled
+    loop length a power of two bounds the set of distinct XLA programs per
+    mesh at O(log MAX_CHUNK)."""
+    k = chunk
+    while k > remaining:
+        k //= 2
+    return max(k, 1)
+
+
+class Engine:
+    """Holds (world, turn) authoritatively across runs — the detach/resume
+    contract (reference broker globals `world`/`turn`, `Server:29-30`, and
+    the `CONT=yes` path, `Local/gol/distributor.go:171-178`)."""
+
+    def __init__(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        rule: LifeLikeRule = CONWAY,
+    ) -> None:
+        self._devices = list(devices if devices is not None else jax.devices())
+        self._rule = rule
+        self._state_lock = threading.Lock()
+        self._cells: Optional[jax.Array] = None  # row-sharded {0,1} uint8
+        self._turn = 0
+        self._flags: "queue.Queue[int]" = queue.Queue()
+        self._killed = False
+        self._running = False
+
+    # ------------------------------------------------------------------ RPC
+
+    def server_distributor(
+        self,
+        params: Params,
+        world: np.ndarray,
+        sub_workers: Sequence[str] = (),
+        start_turn: int = 0,
+    ) -> Tuple[np.ndarray, int]:
+        """Blocking run: evolve `world` for `params.turns` turns, honouring
+        control flags between chunks. Returns ({0,255} board, completed turn).
+
+        `sub_workers` mirrors the reference's worker-address list
+        (`SUB`, `Local/gol/distributor.go:100-105`): its length is the
+        requested shard count. `start_turn` carries the resume arithmetic
+        explicitly (the reference keeps it in a broker global).
+        """
+        self._check_alive()
+        if self._running:
+            raise RuntimeError("engine already running a board")
+
+        height, width = world.shape
+        # Shard-count request: worker-list length (reference SUB), falling
+        # back to the `threads` hint (reference per-worker fan-out param).
+        requested = len(sub_workers) if sub_workers else params.threads
+        requested = min(requested, len(self._devices))
+        n_shards = resolve_shard_count(height, requested)
+        mesh = make_mesh(n_shards, self._devices)
+
+        cells = shard_board(from_pixels(world), mesh)
+        with self._state_lock:
+            if self._running:  # re-check under the lock (TOCTOU)
+                raise RuntimeError("engine already running a board")
+            self._cells = cells
+            self._turn = start_turn
+            self._running = True
+
+        target = start_turn + params.turns
+        chunk = 1
+        quit_run = False
+        try:
+            while self._turn < target and not quit_run:
+                if self._killed:
+                    break
+                k = _next_chunk(chunk, target - self._turn)
+                t0 = time.monotonic()
+                cells = sharded_run_turns(cells, k, mesh, self._rule)
+                cells.block_until_ready()
+                elapsed = time.monotonic() - t0
+                with self._state_lock:
+                    self._cells = cells
+                    self._turn += k
+                chunk = self._adapt_chunk(chunk, k, elapsed)
+                if self._turn < target:
+                    # Only honour flags while turns remain — a pause landing
+                    # with the final chunk must not park a finished run.
+                    quit_run = self._handle_flags()
+        finally:
+            with self._state_lock:
+                self._running = False
+        # On kill_prog mid-run, still hand back the partial board — the
+        # state exists and discarding completed turns helps nobody; further
+        # RPCs on this engine raise EngineKilled.
+        return self._snapshot()
+
+    def alive_count(self) -> Tuple[int, int]:
+        """(alive, completed turn), coherent pair (ref `Server:69-75`)."""
+        self._check_alive()
+        with self._state_lock:
+            cells, turn = self._cells, self._turn
+        if cells is None:
+            return 0, turn
+        return alive_count_exact(cells), turn
+
+    def get_world(self) -> Tuple[np.ndarray, int]:
+        """({0,255} board snapshot, completed turn) (ref `Server:62-67`)."""
+        self._check_alive()
+        return self._snapshot()
+
+    def cf_put(self, flag: int) -> None:
+        """Post a control flag (ref `Server:54-60`)."""
+        self._check_alive()
+        if flag not in (FLAG_PAUSE, FLAG_QUIT, FLAG_KILL):
+            raise ValueError(f"unknown control flag {flag}")
+        self._flags.put(flag)
+
+    def drain_flags(self) -> None:
+        """Discard queued control flags. A controller calls this once when
+        it attaches, BEFORE it starts forwarding keypresses, so flags left
+        over from a previous (detached/dead) controller session can't
+        poison the new run, while the new controller's own early flags are
+        honoured (reference analog: the broker's flag channel is emptied by
+        its per-turn sentinel cycle, `Server:136-150`)."""
+        self._check_alive()
+        while True:
+            try:
+                self._flags.get_nowait()
+            except queue.Empty:
+                return
+
+    def kill_prog(self) -> None:
+        """Mark the engine dead (ref `Server:77-80`, worker os.Exit)."""
+        self._killed = True
+
+    # ------------------------------------------------------------- internals
+
+    def _check_alive(self) -> None:
+        if self._killed:
+            raise EngineKilled("engine has been killed")
+
+    def _snapshot(self) -> Tuple[np.ndarray, int]:
+        with self._state_lock:
+            cells, turn = self._cells, self._turn
+        if cells is None:
+            raise RuntimeError("no board loaded")
+        return np.asarray(jax.device_get(to_pixels(cells))), turn
+
+    def _adapt_chunk(self, chunk: int, k: int, elapsed: float) -> int:
+        """Double/halve the power-of-two chunk toward CHUNK_TARGET_SECONDS."""
+        if k != chunk:
+            return chunk  # partial (remainder) chunk — timing unrepresentative
+        if elapsed < CHUNK_TARGET_SECONDS / 2 and chunk < MAX_CHUNK:
+            return chunk * 2
+        if elapsed > CHUNK_TARGET_SECONDS * 2 and chunk > 1:
+            return chunk // 2
+        return chunk
+
+    def _handle_flags(self) -> bool:
+        """Drain flags; block while paused. Returns True to quit the run
+        (reference handshake `Server/gol/distributor.go:136-164`)."""
+        paused = False
+        while True:
+            if self._killed:
+                return True
+            try:
+                flag = self._flags.get_nowait() if not paused \
+                    else self._flags.get(timeout=0.05)
+            except queue.Empty:
+                if not paused:
+                    return False
+                continue
+            if flag == FLAG_PAUSE:
+                paused = not paused
+                if not paused:
+                    return False
+            elif flag in (FLAG_QUIT, FLAG_KILL):
+                # Both break the run loop and still return the board to the
+                # controller; on kill the reference broker first downs its
+                # workers then returns, and only dies when the controller
+                # calls KillProg afterwards (`Server:157-164`,
+                # `Local/gol/distributor.go:213-216`). Our "workers" are the
+                # compiled program — nothing to down until kill_prog().
+                return True

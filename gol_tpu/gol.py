@@ -1,0 +1,38 @@
+"""Top-level entry: `run(params, events, key_presses)`.
+
+Counterpart of reference `gol.Run` (`Local/gol/gol.go:12-40`), which wires
+the distributor and io goroutines to the caller's channels and returns.
+Here io is synchronous inside the distributor (the Go one-byte-per-send io
+goroutine is an artifact of its channel design), so `run` starts a single
+distributor thread and returns it; callers (tests, CLI) consume `events`
+until the CLOSE sentinel, exactly like ranging over the Go events channel.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from gol_tpu.distributor import distributor
+from gol_tpu.params import Params
+
+
+def run(
+    p: Params,
+    events: "queue.Queue",
+    key_presses: Optional["queue.Queue"] = None,
+    engine=None,
+    images_dir: Optional[str] = None,
+    out_dir: Optional[str] = None,
+    live_view: bool = False,
+) -> threading.Thread:
+    t = threading.Thread(
+        target=distributor,
+        args=(p, events, key_presses, engine, images_dir, out_dir,
+              live_view),
+        daemon=True,
+        name="gol-distributor",
+    )
+    t.start()
+    return t

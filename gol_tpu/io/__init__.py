@@ -1,0 +1,8 @@
+from gol_tpu.io.pgm import (
+    input_path,
+    output_path,
+    read_pgm,
+    write_pgm,
+)
+
+__all__ = ["input_path", "output_path", "read_pgm", "write_pgm"]

@@ -1,0 +1,97 @@
+"""PGM (P5) board I/O, byte-compatible with the reference's formats.
+
+Counterpart of reference `Local/gol/io.go:42-121`, minus the Go version's
+one-byte-per-channel-send streaming (an artifact of its goroutine design):
+boards are numpy arrays and hit disk in one write. Contracts preserved:
+
+* input path  `images/{W}x{H}.pgm`          (`Local/gol/distributor.go:76-77`)
+* output path `out/{W}x{H}x{TURN}.pgm`      (`Local/gol/distributor.go:201`)
+* P5 binary, maxval MUST be 255             (`io.go:109-111`)
+* payload bytes strictly {0, 255}           (kernel contract, SURVEY §5)
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+MAGIC = b"P5"
+MAXVAL = 255
+
+
+def input_path(width: int, height: int, images_dir: str = "images") -> str:
+    return os.path.join(images_dir, f"{width}x{height}.pgm")
+
+
+def output_path(
+    width: int, height: int, turn: int, out_dir: str = "out"
+) -> str:
+    return os.path.join(out_dir, f"{width}x{height}x{turn}.pgm")
+
+
+def _read_token(buf: bytes, pos: int) -> tuple[bytes, int]:
+    """Read one whitespace-delimited header token, skipping '#' comments."""
+    n = len(buf)
+    while pos < n:
+        c = buf[pos : pos + 1]
+        if c == b"#":
+            while pos < n and buf[pos : pos + 1] != b"\n":
+                pos += 1
+        elif c.isspace():
+            pos += 1
+        else:
+            break
+    start = pos
+    while pos < n and not buf[pos : pos + 1].isspace():
+        pos += 1
+    if start == pos:
+        raise ValueError("truncated PGM header")
+    return buf[start:pos], pos
+
+
+def read_pgm(path: str) -> np.ndarray:
+    """Read a P5 PGM into an (H, W) uint8 array of {0, 255}.
+
+    Stricter than the reference reader (which indexes `fields[4]` and is
+    only safe because GoL payload bytes are never whitespace, `io.go:93-114`):
+    this one tokenizes the header properly and then takes exactly W*H
+    payload bytes after the single whitespace byte that ends the header.
+    """
+    with open(path, "rb") as f:
+        buf = f.read()
+    magic, pos = _read_token(buf, 0)
+    if magic != MAGIC:
+        raise ValueError(f"{path}: not a P5 PGM (magic {magic!r})")
+    wtok, pos = _read_token(buf, pos)
+    htok, pos = _read_token(buf, pos)
+    mtok, pos = _read_token(buf, pos)
+    width, height, maxval = int(wtok), int(htok), int(mtok)
+    if maxval != MAXVAL:
+        raise ValueError(f"{path}: maxval must be {MAXVAL}, got {maxval}")
+    pos += 1  # exactly one whitespace byte separates header from payload
+    payload = buf[pos : pos + width * height]
+    if len(payload) != width * height:
+        raise ValueError(
+            f"{path}: expected {width * height} payload bytes, "
+            f"got {len(payload)}"
+        )
+    board = np.frombuffer(payload, dtype=np.uint8).reshape(height, width)
+    bad = ~np.isin(board, (0, MAXVAL))
+    if bad.any():
+        raise ValueError(f"{path}: {int(bad.sum())} cells not in {{0, 255}}")
+    return board.copy()
+
+
+def write_pgm(path: str, board: np.ndarray) -> None:
+    """Write an (H, W) uint8 {0, 255} board as P5 (`io.go:42-85`)."""
+    if board.dtype != np.uint8 or board.ndim != 2:
+        raise ValueError(f"board must be 2-D uint8, got {board.dtype} "
+                         f"shape {board.shape}")
+    height, width = board.shape
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(MAGIC + b"\n")
+        f.write(f"{width} {height}\n".encode())
+        f.write(f"{MAXVAL}\n".encode())
+        f.write(board.tobytes())

@@ -1,0 +1,57 @@
+"""CLI entry — counterpart of reference `Local/main.go:12-51`.
+
+Flags mirror the Go CLI: `-t` threads (default 8), `-w` width (512),
+`-h` height (512), `--turns` (default 10_000_000_000 ≙ "run until
+keypress", `Local/main.go:37`). Env mirrors the reference too: `SER`
+(engine address — empty means in-process engine), `SUB` (worker list →
+shard-count request), `CONT=yes` (reattach).
+
+    python -m gol_tpu.main -w 512 -h 512 --turns 100
+    python -m gol_tpu.main --headless --live   # ANSI live view off/on
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import sys
+
+from gol_tpu.gol import run
+from gol_tpu.params import Params
+from gol_tpu.sdl.loop import start as view_start
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        description="TPU-native distributed Game of Life", add_help=False
+    )
+    ap.add_argument("--help", action="help")
+    ap.add_argument("-t", "--threads", type=int, default=8,
+                    help="worker shard hint (reference thread count)")
+    ap.add_argument("-w", "--width", type=int, default=512)
+    ap.add_argument("-h", "--height", type=int, default=512)
+    ap.add_argument("--turns", type=int, default=10_000_000_000)
+    ap.add_argument("--headless", action="store_true",
+                    help="no window / terminal rendering, events printed")
+    ap.add_argument("--live", action="store_true",
+                    help="enable the live board view (polls snapshots)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    p = Params(
+        threads=args.threads,
+        image_width=args.width,
+        image_height=args.height,
+        turns=args.turns,
+    )
+    events_q: "queue.Queue" = queue.Queue(maxsize=10000)
+    key_presses: "queue.Queue" = queue.Queue(maxsize=10)
+    run(p, events_q, key_presses, live_view=args.live)
+    view_start(p, events_q, key_presses, headless=args.headless)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

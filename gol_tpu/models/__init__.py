@@ -1,0 +1,3 @@
+from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+
+__all__ = ["CONWAY", "LifeLikeRule"]

@@ -1,0 +1,58 @@
+"""Life-like cellular automaton rule family.
+
+The reference hardcodes Conway's B3/S23 as four branchy rules
+(`SubServer/distributor.go:179-201`). The TPU-native generalization is a
+rule *model*: any outer-totalistic life-like rule "B{digits}/S{digits}" is
+two 9-entry lookup tables (born-by-neighbour-count, survive-by-neighbour-
+count), which the kernel applies as a vectorized gather — so every rule in
+the family compiles to the identical XLA program shape, and Conway is just
+one point in the family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Tuple
+
+_RULE_RE = re.compile(r"^B(?P<b>[0-8]*)/S(?P<s>[0-8]*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class LifeLikeRule:
+    """An outer-totalistic rule, hashable so it can be a jit static arg."""
+
+    rulestring: str = "B3/S23"
+
+    def __post_init__(self) -> None:
+        if _RULE_RE.match(self.rulestring) is None:
+            raise ValueError(
+                f"bad rulestring {self.rulestring!r}; want e.g. 'B3/S23'"
+            )
+
+    @property
+    def born(self) -> frozenset:
+        m = _RULE_RE.match(self.rulestring)
+        return frozenset(int(c) for c in m.group("b"))
+
+    @property
+    def survive(self) -> frozenset:
+        m = _RULE_RE.match(self.rulestring)
+        return frozenset(int(c) for c in m.group("s"))
+
+    def luts(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """(born_lut, survive_lut): 9-tuples of 0/1 indexed by live-neighbour
+        count."""
+        born = tuple(1 if i in self.born else 0 for i in range(9))
+        survive = tuple(1 if i in self.survive else 0 for i in range(9))
+        return born, survive
+
+    @property
+    def is_conway(self) -> bool:
+        return self.born == frozenset({3}) and self.survive == frozenset({2, 3})
+
+
+CONWAY = LifeLikeRule("B3/S23")
+HIGHLIFE = LifeLikeRule("B36/S23")
+DAY_AND_NIGHT = LifeLikeRule("B3678/S34678")
+SEEDS = LifeLikeRule("B2/S")

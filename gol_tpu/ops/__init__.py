@@ -1,0 +1,17 @@
+from gol_tpu.ops.stencil import (
+    alive_count,
+    from_pixels,
+    neighbour_counts,
+    run_turns,
+    step,
+    to_pixels,
+)
+
+__all__ = [
+    "alive_count",
+    "from_pixels",
+    "neighbour_counts",
+    "run_turns",
+    "step",
+    "to_pixels",
+]

@@ -1,0 +1,36 @@
+"""Independent numpy oracle used only to generate/verify golden fixtures.
+
+Deliberately structured differently from the JAX kernel (explicit padded
+window slicing rather than roll-sums) so a bug in one is unlikely to hide in
+the other. Golden boards/counts produced by this module play the role of the
+reference's committed `Local/check/` fixtures (SURVEY §4 notes they are
+regenerable — GoL is deterministic).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def step_np(board01: np.ndarray) -> np.ndarray:
+    """One torus turn on an (H, W) uint8 {0,1} board."""
+    p = np.pad(board01, 1, mode="wrap")
+    h, w = board01.shape
+    counts = np.zeros((h, w), dtype=np.int32)
+    for dy in (0, 1, 2):
+        for dx in (0, 1, 2):
+            if dy == 1 and dx == 1:
+                continue
+            counts += p[dy : dy + h, dx : dx + w]
+    alive = board01 == 1
+    nxt = np.where(
+        alive, (counts == 2) | (counts == 3), counts == 3
+    )
+    return nxt.astype(np.uint8)
+
+
+def run_turns_np(board01: np.ndarray, num_turns: int) -> np.ndarray:
+    b = board01.copy()
+    for _ in range(num_turns):
+        b = step_np(b)
+    return b

@@ -1,0 +1,16 @@
+from gol_tpu.parallel.mesh import (
+    ROWS_AXIS,
+    board_sharding,
+    make_mesh,
+    resolve_shard_count,
+)
+from gol_tpu.parallel.halo import sharded_run_turns, shard_board
+
+__all__ = [
+    "ROWS_AXIS",
+    "board_sharding",
+    "make_mesh",
+    "resolve_shard_count",
+    "sharded_run_turns",
+    "shard_board",
+]

@@ -1,0 +1,50 @@
+"""Device-mesh construction and shard-count policy.
+
+The TPU-native replacement for the reference's worker topology: where the Go
+broker dials a list of worker addresses and row-strips the board across them
+(`Server/gol/distributor.go:85-120`), we lay a 1-D `jax.sharding.Mesh` over
+the available chips and shard the board's row axis. The worker-address list
+(`SUB` env, `Local/gol/distributor.go:100-105`) maps to a *requested shard
+count*; goroutine `Threads` parallelism is subsumed by XLA within a chip.
+
+Non-divisible heights: the reference spreads `H mod N` remainder rows across
+the first strips (`Server:106-116`). Equal-shape sharding can't do that, and
+padding would break the torus, so the policy (documented, SURVEY §7 hard
+part 3) is: use the largest shard count ≤ requested that divides H. All
+benchmark boards (16..65536) are powers of two, where this is the identity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS_AXIS = "rows"
+
+
+def resolve_shard_count(height: int, requested: int) -> int:
+    """Largest n ≤ requested with height % n == 0 (and n ≥ 1)."""
+    n = max(1, min(requested, height))
+    while height % n != 0:
+        n -= 1
+    return n
+
+
+def make_mesh(
+    num_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D mesh over the first `num_shards` devices, axis name 'rows'."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = num_shards if num_shards is not None else len(devices)
+    if n > len(devices):
+        raise ValueError(f"asked for {n} shards, have {len(devices)} devices")
+    return Mesh(np.array(devices[:n]), (ROWS_AXIS,))
+
+
+def board_sharding(mesh: Mesh) -> NamedSharding:
+    """Board rows split over the mesh, columns replicated."""
+    return NamedSharding(mesh, P(ROWS_AXIS, None))

@@ -1,0 +1,32 @@
+"""Run parameters.
+
+Mirrors the reference `gol.Params` struct (`Local/gol/gol.go:4-10`): the one
+config object, forwarded verbatim from CLI to engine. `threads` is kept for
+API parity with the reference's per-worker goroutine fan-out
+(`SubServer/distributor.go:49-69`); on TPU intra-chip parallelism is XLA's
+job, so `threads` only caps the *requested* shard count hint when no explicit
+worker list is given.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    threads: int = 8
+    image_width: int = 512
+    image_height: int = 512
+    turns: int = 100
+
+    def __post_init__(self) -> None:
+        if self.image_width <= 0 or self.image_height <= 0:
+            raise ValueError(
+                f"board must be non-empty, got "
+                f"{self.image_width}x{self.image_height}"
+            )
+        if self.turns < 0:
+            raise ValueError(f"turns must be >= 0, got {self.turns}")
+        if self.threads <= 0:
+            raise ValueError(f"threads must be >= 1, got {self.threads}")

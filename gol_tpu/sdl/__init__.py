@@ -1,0 +1,4 @@
+from gol_tpu.sdl.loop import start
+from gol_tpu.sdl.window import Window, sdl_available
+
+__all__ = ["start", "Window", "sdl_available"]

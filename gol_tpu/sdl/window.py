@@ -1,0 +1,165 @@
+"""Board window: SDL2 when the native library is present, ANSI terminal
+renderer otherwise.
+
+Counterpart of reference `Local/sdl/window.go:20-82` (cgo → libSDL2: window,
+ARGB texture, SetPixel/FlipPixel/RenderFrame/PollEvent). The native path
+binds libSDL2 directly via ctypes — same C library, no cgo shim needed. The
+fallback renders two board rows per character line with Unicode half-blocks,
+giving a live view on any terminal (parity with the reference's ASCII
+renderer role, `Local/util/visualise.go`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import sys
+from typing import Optional
+
+import numpy as np
+
+_SDL_INIT_VIDEO = 0x20
+_SDL_WINDOWPOS_CENTERED = 0x2FFF0000
+_SDL_PIXELFORMAT_ARGB8888 = 0x16362004
+_SDL_TEXTUREACCESS_STREAMING = 1
+_SDL_QUIT = 0x100
+_SDL_KEYDOWN = 0x300
+
+
+def _load_sdl():
+    name = ctypes.util.find_library("SDL2")
+    if not name:
+        return None
+    try:
+        return ctypes.CDLL(name)
+    except OSError:
+        return None
+
+
+_SDL = _load_sdl()
+
+
+def sdl_available() -> bool:
+    return _SDL is not None
+
+
+class Window:
+    """FlipPixel/RenderFrame/PollEvent surface over SDL2 or a terminal."""
+
+    def __init__(self, width: int, height: int, scale: int = 1) -> None:
+        self.width, self.height = width, height
+        self._pixels = np.zeros((height, width), dtype=bool)
+        self._sdl = None
+        if _SDL is not None and os.environ.get("GOL_HEADLESS", "") != "1":
+            self._init_sdl(scale)
+
+    # --- pixel ops (reference `window.go:68-82`) --------------------------
+
+    def set_pixel(self, x: int, y: int, alive: bool) -> None:
+        self._pixels[y % self.height, x % self.width] = alive
+
+    def flip_pixel(self, x: int, y: int) -> None:
+        self._pixels[y % self.height, x % self.width] ^= True
+
+    def set_board(self, board01: np.ndarray) -> None:
+        self._pixels = board01.astype(bool)
+
+    # --- rendering --------------------------------------------------------
+
+    def render_frame(self, status: str = "") -> None:
+        if self._sdl is not None:
+            self._render_sdl()
+        elif sys.stdout.isatty():
+            self._render_ansi(status)
+
+    def _render_ansi(self, status: str) -> None:
+        h, w = self._pixels.shape
+        max_rows = 48 * 2
+        max_cols = 160
+        p = self._pixels[:max_rows, :max_cols]
+        if p.shape[0] % 2:
+            p = np.vstack([p, np.zeros((1, p.shape[1]), dtype=bool)])
+        top, bot = p[0::2], p[1::2]
+        glyphs = np.array([" ", "▄", "▀", "█"])
+        frame = "\n".join(
+            "".join(row)
+            for row in glyphs[(top.astype(int) << 1) | bot.astype(int)]
+        )
+        sys.stdout.write(
+            "\x1b[H\x1b[2J" + frame + "\n" + status + "\n"
+        )
+        sys.stdout.flush()
+
+    def poll_event(self) -> Optional[str]:
+        """Returns 'q'/'p'/'s'/'k' on keydown, 'quit' on window close."""
+        if self._sdl is None:
+            return None
+        event = (ctypes.c_byte * 64)()
+        while _SDL.SDL_PollEvent(ctypes.byref(event)):
+            etype = ctypes.cast(
+                event, ctypes.POINTER(ctypes.c_uint32)
+            ).contents.value
+            if etype == _SDL_QUIT:
+                return "quit"
+            if etype == _SDL_KEYDOWN:
+                # SDL_KeyboardEvent: keysym.sym at offset 20 (x86-64 ABI)
+                sym = ctypes.cast(
+                    ctypes.byref(event, 20),
+                    ctypes.POINTER(ctypes.c_int32),
+                ).contents.value
+                ch = chr(sym) if 0 < sym < 128 else ""
+                if ch in "spqk":
+                    return ch
+        return None
+
+    def close(self) -> None:
+        if self._sdl is not None:
+            _SDL.SDL_DestroyWindow(self._win)
+            _SDL.SDL_Quit()
+            self._sdl = None
+
+    # --- SDL internals ----------------------------------------------------
+
+    def _init_sdl(self, scale: int) -> None:
+        if _SDL.SDL_Init(_SDL_INIT_VIDEO) != 0:
+            return
+        _SDL.SDL_CreateWindow.restype = ctypes.c_void_p
+        self._win = _SDL.SDL_CreateWindow(
+            b"gol_tpu",
+            _SDL_WINDOWPOS_CENTERED,
+            _SDL_WINDOWPOS_CENTERED,
+            self.width * scale,
+            self.height * scale,
+            0,
+        )
+        if not self._win:
+            return
+        _SDL.SDL_CreateRenderer.restype = ctypes.c_void_p
+        self._ren = _SDL.SDL_CreateRenderer(
+            ctypes.c_void_p(self._win), -1, 0
+        )
+        _SDL.SDL_CreateTexture.restype = ctypes.c_void_p
+        self._tex = _SDL.SDL_CreateTexture(
+            ctypes.c_void_p(self._ren),
+            _SDL_PIXELFORMAT_ARGB8888,
+            _SDL_TEXTUREACCESS_STREAMING,
+            self.width,
+            self.height,
+        )
+        self._sdl = _SDL
+
+    def _render_sdl(self) -> None:
+        argb = np.where(
+            self._pixels, np.uint32(0xFFFFFFFF), np.uint32(0xFF000000)
+        ).astype(np.uint32)
+        buf = argb.tobytes()
+        self._sdl.SDL_UpdateTexture(
+            ctypes.c_void_p(self._tex), None, buf, self.width * 4
+        )
+        self._sdl.SDL_RenderClear(ctypes.c_void_p(self._ren))
+        self._sdl.SDL_RenderCopy(
+            ctypes.c_void_p(self._ren), ctypes.c_void_p(self._tex),
+            None, None,
+        )
+        self._sdl.SDL_RenderPresent(ctypes.c_void_p(self._ren))

@@ -1,0 +1,133 @@
+"""Engine server: the broker-host process of the reference, TPU-native.
+
+Wraps an `Engine` behind the 5-method control protocol
+(`Server/gol/distributor.go:54-83` — ServerDistributor / Alivecount /
+GetWorld / CFput / KillProg) on a TCP socket (default :8080, the reference
+broker port, `Server:235`). Long-running: survives controller detach and
+serves `GetWorld` for `CONT=yes` reattach, exactly like the Go broker
+holding `world`/`turn` in globals.
+
+Run:  python -m gol_tpu.server [--port 8080]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import threading
+from typing import Optional
+
+import numpy as np
+
+from gol_tpu.engine import Engine, EngineKilled
+from gol_tpu.params import Params
+from gol_tpu.wire import recv_msg, send_msg
+
+DEFAULT_PORT = 8080  # reference broker port (`Server/gol/distributor.go:235`)
+
+
+class EngineServer:
+    def __init__(
+        self,
+        port: int = DEFAULT_PORT,
+        host: str = "0.0.0.0",
+        engine: Optional[Engine] = None,
+    ) -> None:
+        self.engine = engine if engine is not None else Engine()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._shutdown = threading.Event()
+
+    def serve_forever(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                header, world = recv_msg(conn)
+                self._dispatch(conn, header, world)
+        except (ConnectionError, OSError, ValueError):
+            pass
+
+    def _dispatch(
+        self, conn: socket.socket, header: dict, world
+    ) -> None:
+        method = header.get("method")
+        try:
+            if method == "ServerDistributor":
+                p = Params(**header["params"])
+                out, turn = self.engine.server_distributor(
+                    p,
+                    world,
+                    tuple(header.get("sub_workers", ())),
+                    start_turn=int(header.get("start_turn", 0)),
+                )
+                send_msg(conn, {"ok": True, "turn": turn}, out)
+            elif method == "Alivecount":
+                alive, turn = self.engine.alive_count()
+                send_msg(conn, {"ok": True, "alive": alive, "turn": turn})
+            elif method == "GetWorld":
+                out, turn = self.engine.get_world()
+                send_msg(conn, {"ok": True, "turn": turn}, out)
+            elif method == "CFput":
+                self.engine.cf_put(int(header["flag"]))
+                send_msg(conn, {"ok": True})
+            elif method == "DrainFlags":
+                self.engine.drain_flags()
+                send_msg(conn, {"ok": True})
+            elif method == "KillProg":
+                self.engine.kill_prog()
+                send_msg(conn, {"ok": True})
+                # Reference broker/worker die on KillProg (os.Exit(0),
+                # `SubServer/distributor.go:42-45`): bring the server down.
+                self.shutdown()
+                if os.environ.get("GOL_SERVER_EXIT_ON_KILL", "1") == "1":
+                    threading.Timer(0.2, lambda: os._exit(0)).start()
+            else:
+                send_msg(conn, {"ok": False,
+                                "error": f"unknown method {method!r}"})
+        except EngineKilled as e:
+            send_msg(conn, {"ok": False, "error": f"killed: {e}"})
+        except Exception as e:  # surface engine errors to the client
+            send_msg(conn, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="gol_tpu engine server")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("GOL_PORT", DEFAULT_PORT)))
+    ap.add_argument("--host", default="0.0.0.0")
+    args = ap.parse_args()
+    srv = EngineServer(port=args.port, host=args.host)
+    print(f"gol_tpu engine serving on :{srv.port} "
+          f"({len(np.atleast_1d(srv.engine._devices))} device(s))")
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

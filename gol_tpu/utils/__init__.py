@@ -1,0 +1,12 @@
+from gol_tpu.utils.cell import Cell, alive_cells_from_board, read_alive_cells
+from gol_tpu.utils.check import check
+from gol_tpu.utils.visualise import alive_cells_to_string, board_diff
+
+__all__ = [
+    "Cell",
+    "alive_cells_from_board",
+    "read_alive_cells",
+    "check",
+    "alive_cells_to_string",
+    "board_diff",
+]

@@ -1,0 +1,48 @@
+"""Cell type and PGM→alive-cell-list parsing.
+
+Test-support counterpart of reference `Local/util/cell.go:10-56`:
+`Cell{X, Y}` with X = column, Y = row, and `ReadAliveCells` which parses a
+P5 PGM into the unordered set of alive cells (value 255).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import numpy as np
+
+
+class Cell(NamedTuple):
+    x: int  # column
+    y: int  # row
+
+    def __str__(self) -> str:
+        return f"({self.x}, {self.y})"
+
+
+def alive_cells_from_board(board: np.ndarray) -> List[Cell]:
+    """Alive cells of an (H, W) board of {0, 255} (or {0, 1}) uint8.
+
+    Iteration order is row-major like the reference's y-then-x scan
+    (`Local/gol/distributor.go:78-86`), though consumers treat the result
+    as an unordered set (`Local/gol_test.go:54-82`).
+    """
+    ys, xs = np.nonzero(board)
+    return [Cell(int(x), int(y)) for x, y in zip(xs, ys)]
+
+
+def read_alive_cells(path: str, width: int, height: int) -> List[Cell]:
+    """Parse a P5 PGM into its alive-cell list (reference `cell.go:14-56`).
+
+    Validates the dimensions against the caller's expectation the same way
+    the reference cross-checks the header fields.
+    """
+    from gol_tpu.io.pgm import read_pgm
+
+    board = read_pgm(path)
+    h, w = board.shape
+    if (w, h) != (width, height):
+        raise ValueError(
+            f"{path}: header says {w}x{h}, expected {width}x{height}"
+        )
+    return alive_cells_from_board(board)

@@ -1,0 +1,63 @@
+"""Wire protocol for the controller↔engine control plane.
+
+The reference uses Go net/rpc over HTTP with gob encoding
+(`Server/gol/distributor.go:229-245`); the TPU-native equivalent keeps the
+same 5-method semantic surface (SURVEY §2d) over a deliberately thin
+transport: 4-byte big-endian length prefix + JSON header, with board
+payloads appended as raw bytes after the header (a {0,255} board is already
+its own densest trivial encoding — no base64, no gob).
+
+Message: { "method"/"ok": ..., ...fields..., "world": {"h": H, "w": W}? }
+followed by exactly H*W raw payload bytes when "world" is present.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LEN = struct.Struct(">I")
+MAX_HEADER = 1 << 20
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(
+    sock: socket.socket, header: dict, world: Optional[np.ndarray] = None
+) -> None:
+    header = dict(header)
+    payload = b""
+    if world is not None:
+        if world.dtype != np.uint8 or world.ndim != 2:
+            raise ValueError("world must be 2-D uint8")
+        h, w = world.shape
+        header["world"] = {"h": int(h), "w": int(w)}
+        payload = world.tobytes()
+    raw = json.dumps(header).encode()
+    sock.sendall(_LEN.pack(len(raw)) + raw + payload)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    if n > MAX_HEADER:
+        raise ConnectionError(f"header too large: {n}")
+    header = json.loads(_recv_exact(sock, n))
+    world = None
+    if "world" in header and header["world"] is not None:
+        h, w = int(header["world"]["h"]), int(header["world"]["w"])
+        world = np.frombuffer(
+            _recv_exact(sock, h * w), dtype=np.uint8
+        ).reshape(h, w).copy()
+    return header, world

@@ -1,0 +1,49 @@
+"""Test harness config: force an 8-device virtual CPU mesh BEFORE jax
+imports, so the sharded path (shard_map + ppermute over a Mesh) is exercised
+without real multi-chip hardware — the counterpart of the reference's
+localhost broker + 4 workers story (SURVEY §4)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# A site hook may have force-selected a hardware platform via
+# jax.config.update (which beats the env var); undo it before any backend
+# is initialized so tests run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest
+
+
+@pytest.fixture
+def repo_root() -> pathlib.Path:
+    return REPO_ROOT
+
+
+@pytest.fixture
+def images_dir(repo_root) -> str:
+    return str(repo_root / "images")
+
+
+@pytest.fixture
+def check_dir(repo_root) -> pathlib.Path:
+    return repo_root / "check"
+
+
+@pytest.fixture
+def out_dir(tmp_path) -> str:
+    return str(tmp_path / "out")

@@ -1,0 +1,77 @@
+"""Generate golden fixtures with the independent numpy oracle.
+
+Plays the role of the reference's committed `Local/images/` +
+`Local/check/` fixtures (SURVEY §4: goldens are regenerable — GoL is
+deterministic). We do NOT copy the reference's image bytes; boards are
+seeded-random at the reference's sizes, goldens are recomputed here:
+
+  images/{N}x{N}.pgm                 seeded random inputs
+  check/images/{N}x{N}x{T}.pgm       expected boards, T ∈ {0, 1, 100}
+  check/alive/{N}x{N}.csv            per-turn alive counts, turns 0..10000
+                                     (header `completed_turns,alive_cells`,
+                                     reference `check/alive/*.csv` format)
+
+Run:  python tests/make_fixtures.py
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gol_tpu.io.pgm import write_pgm  # noqa: E402
+from gol_tpu.ops.reference import step_np  # noqa: E402
+
+GOLDEN_SIZES = (16, 64, 512)  # reference correctness sizes (gol_test.go:12)
+EXTRA_SIZES = (128, 256)  # reference benchmark-intent inputs (Local/images/)
+GOLDEN_TURNS = (0, 1, 100)  # reference check/images turns
+CSV_TURNS = 10_000  # reference check/alive CSV depth
+DENSITY = 0.25
+SEED = 20260729
+
+
+def make_board(n: int) -> np.ndarray:
+    rng = np.random.default_rng(SEED + n)
+    return (rng.random((n, n)) < DENSITY).astype(np.uint8)
+
+
+def main() -> None:
+    root = pathlib.Path(__file__).resolve().parent.parent
+    images = root / "images"
+    check_images = root / "check" / "images"
+    check_alive = root / "check" / "alive"
+    for d in (images, check_images, check_alive):
+        os.makedirs(d, exist_ok=True)
+
+    for n in GOLDEN_SIZES + EXTRA_SIZES:
+        board = make_board(n)
+        write_pgm(str(images / f"{n}x{n}.pgm"), board * np.uint8(255))
+        if n not in GOLDEN_SIZES:
+            continue
+        counts = [int(board.sum())]
+        b = board
+        for turn in range(1, CSV_TURNS + 1):
+            b = step_np(b)
+            counts.append(int(b.sum()))
+            if turn in GOLDEN_TURNS:
+                write_pgm(
+                    str(check_images / f"{n}x{n}x{turn}.pgm"),
+                    b * np.uint8(255),
+                )
+        write_pgm(
+            str(check_images / f"{n}x{n}x0.pgm"), board * np.uint8(255)
+        )
+        with open(check_alive / f"{n}x{n}.csv", "w") as f:
+            f.write("completed_turns,alive_cells\n")
+            for turn, c in enumerate(counts):
+                f.write(f"{turn},{c}\n")
+        print(f"{n}x{n}: turn-{CSV_TURNS} alive={counts[-1]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""Interactive control + fault-tolerance contract: snapshot ('s'),
+pause/resume ('p'), detach ('q') + reattach (`CONT=yes`), kill ('k') —
+reference `Local/gol/distributor.go:107-152,171-178` and SURVEY §3.3."""
+
+import os
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import Params, events as ev, run
+from gol_tpu.engine import Engine, EngineKilled
+from gol_tpu.io.pgm import read_pgm
+from gol_tpu.ops.reference import run_turns_np
+
+
+def _wait_for(events_q, kind, timeout=30):
+    end = time.monotonic() + timeout
+    seen = []
+    while time.monotonic() < end:
+        try:
+            e = events_q.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        seen.append(e)
+        if isinstance(e, kind):
+            return e, seen
+    raise AssertionError(f"no {kind.__name__} within {timeout}s: {seen}")
+
+
+def _drain_to_close(events_q, timeout=30):
+    end = time.monotonic() + timeout
+    out = []
+    while time.monotonic() < end:
+        try:
+            e = events_q.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        if e is ev.CLOSE:
+            return out
+        out.append(e)
+    raise AssertionError("events never closed")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    monkeypatch.delenv("SUB", raising=False)
+
+
+def test_snapshot_keypress(images_dir, out_dir, monkeypatch):
+    p = Params(threads=1, image_width=64, image_height=64, turns=10**8)
+    events_q, keys = queue.Queue(), queue.Queue()
+    run(p, events_q, keys, engine=Engine(),
+        images_dir=images_dir, out_dir=out_dir)
+    time.sleep(1.0)
+    keys.put("s")
+    e, _ = _wait_for(events_q, ev.ImageOutputComplete)
+    assert e.filename == f"64x64x{e.completed_turns}.pgm"
+    snap = read_pgm(os.path.join(out_dir, e.filename))
+    want = run_turns_np(
+        (read_pgm(os.path.join(images_dir, "64x64.pgm")) != 0).astype(
+            np.uint8
+        ),
+        e.completed_turns,
+    )
+    np.testing.assert_array_equal((snap != 0).astype(np.uint8), want)
+    keys.put("q")
+    _drain_to_close(events_q)
+
+
+def test_pause_resume(images_dir, out_dir):
+    p = Params(threads=1, image_width=64, image_height=64, turns=10**8)
+    events_q, keys = queue.Queue(), queue.Queue()
+    run(p, events_q, keys, engine=Engine(),
+        images_dir=images_dir, out_dir=out_dir)
+    time.sleep(0.5)
+    keys.put("p")
+    e, _ = _wait_for(events_q, ev.StateChange)
+    # may first see the initial Executing event
+    while e.new_state != ev.State.PAUSED:
+        e, _ = _wait_for(events_q, ev.StateChange)
+    time.sleep(1.0)  # let the engine actually park between chunks
+    keys.put("p")  # resume
+    e, _ = _wait_for(events_q, ev.StateChange)
+    while e.new_state != ev.State.EXECUTING:
+        e, _ = _wait_for(events_q, ev.StateChange)
+    keys.put("q")
+    evs = _drain_to_close(events_q)
+    assert any(isinstance(x, ev.FinalTurnComplete) for x in evs)
+
+
+def test_pause_actually_stops_turns(images_dir, out_dir):
+    engine = Engine()
+    p = Params(threads=1, image_width=64, image_height=64, turns=10**8)
+    events_q, keys = queue.Queue(), queue.Queue()
+    run(p, events_q, keys, engine=engine,
+        images_dir=images_dir, out_dir=out_dir)
+    time.sleep(1.0)
+    keys.put("p")
+    time.sleep(1.0)  # engine parks between chunks
+    _, t1 = engine.alive_count()
+    time.sleep(1.5)
+    _, t2 = engine.alive_count()
+    assert t1 == t2, f"turn advanced while paused: {t1} -> {t2}"
+    keys.put("p")
+    time.sleep(1.5)
+    _, t3 = engine.alive_count()
+    assert t3 > t2, "turn did not advance after resume"
+    keys.put("q")
+    _drain_to_close(events_q)
+
+
+def test_detach_and_resume_matches_uninterrupted(
+    images_dir, out_dir, monkeypatch
+):
+    """q-detach then CONT=yes reattach must produce exactly the board an
+    uninterrupted run produces (determinism makes this checkable)."""
+    engine = Engine()
+    p = Params(threads=1, image_width=64, image_height=64, turns=10**8)
+    events_q, keys = queue.Queue(), queue.Queue()
+    run(p, events_q, keys, engine=engine,
+        images_dir=images_dir, out_dir=out_dir)
+    time.sleep(1.5)
+    keys.put("q")
+    evs = _drain_to_close(events_q)
+    final1 = [e for e in evs if isinstance(e, ev.FinalTurnComplete)][0]
+    t_detach = final1.completed_turns
+    assert t_detach < 10**8
+
+    # engine stays up holding (world, turn) — reattach for a fixed target.
+    target = t_detach + 50
+    monkeypatch.setenv("CONT", "yes")
+    p2 = Params(threads=1, image_width=64, image_height=64, turns=target)
+    events_q2 = queue.Queue()
+    run(p2, events_q2, None, engine=engine,
+        images_dir=images_dir, out_dir=out_dir)
+    evs2 = _drain_to_close(events_q2)
+    final2 = [e for e in evs2 if isinstance(e, ev.FinalTurnComplete)][0]
+    assert final2.completed_turns == target
+
+    want = run_turns_np(
+        (read_pgm(os.path.join(images_dir, "64x64.pgm")) != 0).astype(
+            np.uint8
+        ),
+        target,
+    )
+    got = np.zeros((64, 64), dtype=np.uint8)
+    for x, y in final2.alive:
+        got[y, x] = 1
+    np.testing.assert_array_equal(got, want)
+
+
+def test_kill(images_dir, out_dir):
+    engine = Engine()
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    events_q, keys = queue.Queue(), queue.Queue()
+    run(p, events_q, keys, engine=engine,
+        images_dir=images_dir, out_dir=out_dir)
+    time.sleep(0.5)
+    keys.put("k")
+    evs = _drain_to_close(events_q)
+    # controller still writes the final PGM then downs the engine
+    # (`Local/gol/distributor.go:194-216`).
+    assert any(isinstance(x, ev.FinalTurnComplete) for x in evs)
+    with pytest.raises(EngineKilled):
+        engine.alive_count()
+
+
+def test_resume_arithmetic_zero_remaining(images_dir, out_dir, monkeypatch):
+    """CONT=yes with turns already ≥ target runs 0 further turns
+    (`p.Turns - TurnCur` clamped, `Local/gol/distributor.go:171-178`)."""
+    engine = Engine()
+    p = Params(threads=1, image_width=16, image_height=16, turns=20)
+    events_q = queue.Queue()
+    run(p, events_q, None, engine=engine,
+        images_dir=images_dir, out_dir=out_dir)
+    _drain_to_close(events_q)
+    monkeypatch.setenv("CONT", "yes")
+    p2 = Params(threads=1, image_width=16, image_height=16, turns=10)
+    events_q2 = queue.Queue()
+    run(p2, events_q2, None, engine=engine,
+        images_dir=images_dir, out_dir=out_dir)
+    evs = _drain_to_close(events_q2)
+    final = [e for e in evs if isinstance(e, ev.FinalTurnComplete)][0]
+    assert final.completed_turns == 20

@@ -1,0 +1,66 @@
+"""Alive-count telemetry — counterpart of reference `TestAlive`
+(`Local/count_test.go:16-66`): 512², effectively-unbounded turns; the first
+`AliveCellsCount` must arrive within 5 s, ticks every ~2 s, and every
+reported (turn, count) pair with turn ≤ 10000 must match the golden CSV
+exactly (counts are only published at exact turn boundaries)."""
+
+import csv
+import queue
+import time
+
+from gol_tpu import Params, events as ev, run
+from gol_tpu.engine import Engine
+
+
+def read_alive_counts(path):
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    return {int(r["completed_turns"]): int(r["alive_cells"]) for r in rows}
+
+
+def test_alive_telemetry(images_dir, check_dir, out_dir, monkeypatch):
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    monkeypatch.delenv("SUB", raising=False)
+    golden = read_alive_counts(str(check_dir / "alive" / "512x512.csv"))
+    p = Params(threads=8, image_width=512, image_height=512, turns=10**8)
+    events_q = queue.Queue()
+    keys = queue.Queue()
+    start = time.monotonic()
+    run(p, events_q, keys, engine=Engine(),
+        images_dir=images_dir, out_dir=out_dir)
+
+    counts = []
+    first_at = None
+    deadline = start + 60
+    while len(counts) < 5 and time.monotonic() < deadline:
+        try:
+            e = events_q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        if e is ev.CLOSE:
+            break
+        if isinstance(e, ev.AliveCellsCount):
+            if first_at is None:
+                first_at = time.monotonic() - start
+            counts.append(e)
+    # first event within 5 s (`count_test.go:29-35`)
+    assert first_at is not None and first_at <= 5.0, first_at
+    assert len(counts) >= 5
+    verified = 0
+    for e in counts:
+        if e.completed_turns <= 10_000:
+            assert golden[e.completed_turns] == e.cells_count, (
+                f"turn {e.completed_turns}: got {e.cells_count}, "
+                f"want {golden[e.completed_turns]}"
+            )
+            verified += 1
+    assert verified >= 1, "no tick landed within the golden CSV range"
+    # quit the unbounded run (`q` keypress, flag 2) and drain to CLOSE.
+    keys.put("q")
+    while True:
+        try:
+            if events_q.get(timeout=30) is ev.CLOSE:
+                break
+        except queue.Empty:
+            raise AssertionError("run did not quit after 'q'")

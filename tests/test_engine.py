@@ -1,0 +1,143 @@
+"""Engine-level unit tests, including regressions for review findings:
+stale control flags across runs, kill during pause, threads-as-shard-hint."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.engine import (
+    Engine,
+    EngineKilled,
+    FLAG_KILL,
+    FLAG_PAUSE,
+    FLAG_QUIT,
+    _next_chunk,
+)
+from gol_tpu.ops.reference import run_turns_np
+from gol_tpu.params import Params
+
+
+def board(h=32, w=32, seed=1):
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < 0.3).astype(np.uint8)) * 255
+
+
+def test_next_chunk():
+    assert _next_chunk(64, 100) == 64
+    assert _next_chunk(64, 63) == 32
+    assert _next_chunk(64, 1) == 1
+    assert _next_chunk(1, 5) == 1
+    assert _next_chunk(8, 0) == 1  # guarded by caller, still sane
+
+
+def test_run_and_resume_state():
+    eng = Engine()
+    w = board()
+    p = Params(threads=4, image_width=32, image_height=32, turns=20)
+    out, turn = eng.server_distributor(p, w)
+    assert turn == 20
+    want = run_turns_np((w != 0).astype(np.uint8), 20)
+    np.testing.assert_array_equal((out != 0).astype(np.uint8), want)
+    # engine holds state for detach/resume
+    snap, t = eng.get_world()
+    assert t == 20
+    np.testing.assert_array_equal(snap, out)
+
+
+def test_stale_flags_drained_at_controller_attach():
+    """Regression: flags left by a dead controller session must not poison
+    the next run — the new controller drains them at attach (as the
+    distributor does), while flags IT posts pre-run are honoured."""
+    eng = Engine()
+    p = Params(threads=1, image_width=16, image_height=16, turns=5)
+    eng.server_distributor(p, board(16, 16))
+    eng.cf_put(FLAG_QUIT)  # stale — e.g. a late keypress after run end
+    eng.cf_put(FLAG_PAUSE)
+    eng.drain_flags()  # next controller attaching
+    out, turn = eng.server_distributor(p, board(16, 16), start_turn=5)
+    assert turn == 10  # ran to completion despite stale flags
+
+
+def test_pause_flag_with_final_chunk_does_not_hang():
+    """Regression: a pause flag that is still queued when the final chunk
+    completes must not park a finished run (flags are only handled while
+    turns remain)."""
+    eng = Engine()
+    p = Params(threads=1, image_width=16, image_height=16, turns=1)
+    eng.cf_put(FLAG_PAUSE)  # single chunk: queued when the run finishes
+    out, turn = eng.server_distributor(p, board(16, 16))
+    assert turn == 1
+
+
+def test_kill_during_pause_unblocks():
+    """Regression: kill_prog() while the engine is parked in pause must
+    terminate the run (returning the partial board), not hang it."""
+    eng = Engine()
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    done = threading.Event()
+
+    def runner():
+        out, turn = eng.server_distributor(p, board(16, 16))
+        assert turn < 10**8
+        done.set()
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    eng.cf_put(FLAG_PAUSE)
+    time.sleep(0.5)  # engine parks
+    eng.kill_prog()
+    assert done.wait(10), "run thread still blocked after kill during pause"
+
+
+def test_threads_hint_caps_shards():
+    """threads acts as the shard-count request when SUB is absent."""
+    eng = Engine()
+    p = Params(threads=3, image_width=30, image_height=30, turns=1)
+    # 30 % 3 == 0 → 3 shards; just verify correctness end-to-end.
+    w = board(30, 30)
+    out, _ = eng.server_distributor(p, w)
+    want = run_turns_np((w != 0).astype(np.uint8), 1)
+    np.testing.assert_array_equal((out != 0).astype(np.uint8), want)
+
+
+def test_kill_flag_returns_board_then_dies():
+    eng = Engine()
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    result = {}
+
+    def runner():
+        result["out"], result["turn"] = eng.server_distributor(
+            p, board(16, 16)
+        )
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    eng.cf_put(FLAG_KILL)
+    t.join(10)
+    assert not t.is_alive()
+    # the run returned a board (controller writes final PGM before killing
+    # the engine, `Local/gol/distributor.go:194-216`)
+    assert "out" in result
+    assert eng._killed is False  # only kill_prog downs the engine
+    eng.kill_prog()
+    with pytest.raises(EngineKilled):
+        eng.alive_count()
+
+
+def test_concurrent_run_rejected():
+    eng = Engine()
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    t = threading.Thread(
+        target=lambda: eng.server_distributor(p, board(16, 16)),
+        daemon=True,
+    )
+    t.start()
+    time.sleep(0.5)
+    with pytest.raises(RuntimeError, match="already running"):
+        eng.server_distributor(p, board(16, 16))
+    eng.cf_put(FLAG_QUIT)
+    t.join(10)

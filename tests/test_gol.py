@@ -1,0 +1,57 @@
+"""Final-board correctness through the full `gol.run` stack — counterpart of
+reference `TestGol` (`Local/gol_test.go:11-43`): sizes × turns × shard
+counts, final alive-cell set compared unordered against golden boards, with
+the ASCII diff printed on small-board failure (`gol_test.go:45-52`)."""
+
+import queue
+
+import pytest
+
+from gol_tpu import Params, events as ev, run
+from gol_tpu.engine import Engine
+from gol_tpu.utils.cell import read_alive_cells
+from gol_tpu.utils.visualise import board_diff
+
+SIZES_TURNS = [
+    (16, 0), (16, 1), (16, 100),
+    (64, 0), (64, 1), (64, 100),
+    (512, 0), (512, 1), (512, 100),
+]
+SHARDS = [1, 4, 8]
+
+
+def run_and_get_final(p, images_dir, out_dir, sub_count, monkeypatch):
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    monkeypatch.setenv(
+        "SUB", ",".join(f"fake:{8030 + i}" for i in range(sub_count))
+    )
+    events_q = queue.Queue()
+    run(p, events_q, None, engine=Engine(),
+        images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(events_q)
+    finals = [e for e in evs if isinstance(e, ev.FinalTurnComplete)]
+    assert len(finals) == 1
+    return finals[0]
+
+
+@pytest.mark.parametrize("shards", SHARDS)
+@pytest.mark.parametrize("size,turns", SIZES_TURNS)
+def test_gol(size, turns, shards, images_dir, check_dir, out_dir,
+             monkeypatch):
+    if size == 512 and shards != 8 and turns == 100:
+        pytest.skip("512x100 covered at 8 shards; keep suite fast")
+    p = Params(threads=8, image_width=size, image_height=size, turns=turns)
+    final = run_and_get_final(p, images_dir, out_dir, shards, monkeypatch)
+    assert final.completed_turns == turns
+    want = {
+        (c.x, c.y)
+        for c in read_alive_cells(
+            str(check_dir / "images" / f"{size}x{size}x{turns}.pgm"),
+            size, size,
+        )
+    }
+    got = set(final.alive)
+    if got != want and size == 16:
+        print(board_diff(sorted(got), sorted(want), size, size))
+    assert got == want

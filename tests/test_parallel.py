@@ -1,0 +1,65 @@
+"""Sharded-path parity: shard_map + ppermute halo stepping must be bitwise
+identical to the single-device kernel at every shard count — the analog of
+the reference's threads-1..16 sweep invariance (`Local/gol_test.go:25`) and
+SURVEY §7 hard part 3 (exact parity at the edges)."""
+
+import jax
+import numpy as np
+import pytest
+
+from gol_tpu.ops.stencil import run_turns
+from gol_tpu.parallel.halo import shard_board, sharded_run_turns
+from gol_tpu.parallel.mesh import (
+    board_sharding,
+    make_mesh,
+    resolve_shard_count,
+)
+
+
+def random_board(h, w, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < 0.3).astype(np.uint8)
+
+
+def test_virtual_device_count():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+@pytest.mark.parametrize("turns", [1, 3, 50])
+def test_sharded_matches_single_device(n_shards, turns):
+    board = random_board(64, 48, seed=n_shards * 100 + turns)
+    mesh = make_mesh(n_shards)
+    sharded = shard_board(board, mesh)
+    got = np.asarray(sharded_run_turns(sharded, turns, mesh))
+    want = np.asarray(run_turns(board, turns))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("n_shards", [2, 8])
+def test_single_row_shards(n_shards):
+    # shards of exactly one row: both halos of a shard come from neighbours.
+    board = random_board(n_shards, 32, seed=7)
+    mesh = make_mesh(n_shards)
+    got = np.asarray(sharded_run_turns(shard_board(board, mesh), 5, mesh))
+    want = np.asarray(run_turns(board, 5))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_resolve_shard_count():
+    # Reference spreads H mod N remainder rows (`Server:106-116`); our
+    # policy instead drops to the largest dividing shard count.
+    assert resolve_shard_count(512, 8) == 8
+    assert resolve_shard_count(12, 8) == 6
+    assert resolve_shard_count(17, 8) == 1  # prime height
+    assert resolve_shard_count(16, 5) == 4
+    assert resolve_shard_count(2, 8) == 2
+    assert resolve_shard_count(1, 8) == 1
+
+
+def test_board_sharding_layout():
+    mesh = make_mesh(4)
+    board = random_board(32, 32)
+    sharded = shard_board(board, mesh)
+    assert sharded.sharding == board_sharding(mesh)
+    np.testing.assert_array_equal(np.asarray(sharded), board)

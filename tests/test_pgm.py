@@ -1,0 +1,64 @@
+"""PGM output correctness through the full stack — counterpart of reference
+`TestPgm` (`Local/pgm_test.go:11-43`): after a run, `out/WxHxT.pgm` must
+parse back to exactly the golden board."""
+
+import queue
+
+import pytest
+
+from gol_tpu import Params, events as ev, run
+from gol_tpu.engine import Engine
+from gol_tpu.utils.cell import read_alive_cells
+
+
+@pytest.mark.parametrize("size,turns", [(16, 100), (64, 100), (512, 1)])
+@pytest.mark.parametrize("shards", [1, 8])
+def test_pgm_output(size, turns, shards, images_dir, check_dir, out_dir,
+                    monkeypatch, tmp_path):
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    monkeypatch.setenv(
+        "SUB", ",".join(f"fake:{8030 + i}" for i in range(shards))
+    )
+    p = Params(threads=8, image_width=size, image_height=size, turns=turns)
+    events_q = queue.Queue()
+    run(p, events_q, None, engine=Engine(),
+        images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(events_q)
+    # output file exists, named out/WxHxT.pgm (`Local/gol/distributor.go:201`)
+    outs = [e for e in evs if isinstance(e, ev.ImageOutputComplete)]
+    assert outs and outs[-1].filename == f"{size}x{size}x{turns}.pgm"
+    got = set(
+        read_alive_cells(f"{out_dir}/{size}x{size}x{turns}.pgm", size, size)
+    )
+    want = set(
+        read_alive_cells(
+            str(check_dir / "images" / f"{size}x{size}x{turns}.pgm"),
+            size, size,
+        )
+    )
+    assert got == want
+
+
+def test_event_ordering(images_dir, out_dir, monkeypatch):
+    """StateChange Executing first; FinalTurnComplete before
+    ImageOutputComplete before StateChange Quitting
+    (`Local/gol/distributor.go:180-226`)."""
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    monkeypatch.delenv("SUB", raising=False)
+    p = Params(threads=1, image_width=16, image_height=16, turns=3)
+    events_q = queue.Queue()
+    run(p, events_q, None, engine=Engine(),
+        images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(events_q)
+    kinds = [type(e).__name__ for e in evs
+             if not isinstance(e, ev.AliveCellsCount)]
+    assert kinds[0] == "StateChange" and evs[0].new_state == ev.State.EXECUTING
+    order = [k for k in kinds if k in
+             ("FinalTurnComplete", "ImageOutputComplete", "StateChange")]
+    assert order[-3:] == [
+        "FinalTurnComplete", "ImageOutputComplete", "StateChange"
+    ]
+    last_sc = [e for e in evs if isinstance(e, ev.StateChange)][-1]
+    assert last_sc.new_state == ev.State.QUITTING

@@ -1,0 +1,71 @@
+"""PGM codec contract tests (reference `Local/gol/io.go:42-121` semantics:
+P5, maxval 255, strict {0,255} payload, WxH / WxHxT filename scheme)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.io.pgm import (
+    input_path,
+    output_path,
+    read_pgm,
+    write_pgm,
+)
+
+
+def test_round_trip(tmp_path):
+    rng = np.random.default_rng(0)
+    board = ((rng.random((33, 47)) < 0.5).astype(np.uint8)) * 255
+    path = str(tmp_path / "b.pgm")
+    write_pgm(path, board)
+    back = read_pgm(path)
+    assert back.dtype == np.uint8
+    np.testing.assert_array_equal(back, board)
+
+
+def test_header_format(tmp_path):
+    board = np.zeros((4, 6), dtype=np.uint8)
+    path = str(tmp_path / "b.pgm")
+    write_pgm(path, board)
+    raw = open(path, "rb").read()
+    assert raw.startswith(b"P5\n6 4\n255\n")
+    assert len(raw) == len(b"P5\n6 4\n255\n") + 24
+
+
+def test_comments_and_whitespace_tolerated(tmp_path):
+    path = str(tmp_path / "c.pgm")
+    with open(path, "wb") as f:
+        f.write(b"P5\n# a comment\n 3\t2 \n255\n" + bytes([0, 255] * 3))
+    board = read_pgm(path)
+    assert board.shape == (2, 3)
+    assert board.sum() == 255 * 3
+
+
+def test_rejects_bad_maxval(tmp_path):
+    path = str(tmp_path / "bad.pgm")
+    with open(path, "wb") as f:
+        f.write(b"P5\n2 2\n15\n" + bytes(4))
+    with pytest.raises(ValueError, match="maxval"):
+        read_pgm(path)
+
+
+def test_rejects_non_binary_payload(tmp_path):
+    path = str(tmp_path / "grey.pgm")
+    with open(path, "wb") as f:
+        f.write(b"P5\n2 2\n255\n" + bytes([0, 127, 255, 0]))
+    with pytest.raises(ValueError, match="not in"):
+        read_pgm(path)
+
+
+def test_rejects_truncated_payload(tmp_path):
+    path = str(tmp_path / "trunc.pgm")
+    with open(path, "wb") as f:
+        f.write(b"P5\n4 4\n255\n" + bytes(7))
+    with pytest.raises(ValueError, match="payload"):
+        read_pgm(path)
+
+
+def test_path_contracts():
+    # `images/WxH.pgm` in, `out/WxHxT.pgm` out
+    # (`Local/gol/distributor.go:76-77,201`).
+    assert input_path(512, 512) == "images/512x512.pgm"
+    assert output_path(512, 512, 100) == "out/512x512x100.pgm"

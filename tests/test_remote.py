@@ -1,0 +1,117 @@
+"""Process-split control plane: EngineServer + RemoteEngine over localhost
+TCP — the counterpart of the reference's localhost broker/worker story
+(SURVEY §4) and its 5-method net/rpc surface (`Server:54-83`)."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import Params, events as ev, run
+from gol_tpu.client import RemoteEngine
+from gol_tpu.engine import Engine, EngineKilled, FLAG_QUIT
+from gol_tpu.ops.reference import run_turns_np
+from gol_tpu.server import EngineServer
+from gol_tpu.utils.cell import read_alive_cells
+
+
+@pytest.fixture
+def server(monkeypatch):
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def test_remote_run_matches_golden(server, images_dir, check_dir, out_dir,
+                                   monkeypatch):
+    monkeypatch.setenv("SER", f"127.0.0.1:{server.port}")
+    monkeypatch.delenv("CONT", raising=False)
+    monkeypatch.delenv("SUB", raising=False)
+    p = Params(threads=8, image_width=64, image_height=64, turns=100)
+    events_q = queue.Queue()
+    run(p, events_q, None, images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(events_q)
+    final = [e for e in evs if isinstance(e, ev.FinalTurnComplete)][0]
+    want = {
+        (c.x, c.y)
+        for c in read_alive_cells(
+            str(check_dir / "images" / "64x64x100.pgm"), 64, 64
+        )
+    }
+    assert set(final.alive) == want
+    assert final.completed_turns == 100
+
+
+def test_remote_rpc_surface(server):
+    eng = RemoteEngine(f"127.0.0.1:{server.port}")
+    world = (np.arange(64 * 32).reshape(32, 64) % 7 == 0).astype(
+        np.uint8
+    ) * 255
+    p = Params(threads=2, image_width=64, image_height=32, turns=10)
+    out, turn = eng.server_distributor(p, world)
+    assert turn == 10
+    want = run_turns_np((world != 0).astype(np.uint8), 10)
+    np.testing.assert_array_equal((out != 0).astype(np.uint8), want)
+
+    alive, turn = eng.alive_count()
+    assert turn == 10 and alive == int(want.sum())
+
+    snap, turn = eng.get_world()
+    np.testing.assert_array_equal(snap, out)
+
+    # resume path: remaining turns with explicit start_turn
+    p2 = Params(threads=2, image_width=64, image_height=32, turns=5)
+    out2, turn2 = eng.server_distributor(p2, snap, start_turn=turn)
+    assert turn2 == 15
+    want2 = run_turns_np(want, 5)
+    np.testing.assert_array_equal((out2 != 0).astype(np.uint8), want2)
+
+
+def test_remote_quit_flag(server):
+    eng = RemoteEngine(f"127.0.0.1:{server.port}")
+    world = np.zeros((16, 16), dtype=np.uint8)
+    world[4:7, 5] = 255  # blinker
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    result = {}
+
+    def blocking_run():
+        result["out"], result["turn"] = eng.server_distributor(p, world)
+
+    t = threading.Thread(target=blocking_run, daemon=True)
+    t.start()
+    time.sleep(1.0)
+    eng.cf_put(FLAG_QUIT)
+    t.join(30)
+    assert not t.is_alive()
+    assert 0 < result["turn"] < 10**8
+    assert (result["out"] != 0).sum() == 3  # blinker population invariant
+
+
+def test_remote_kill(server):
+    eng = RemoteEngine(f"127.0.0.1:{server.port}")
+    eng.kill_prog()
+    with pytest.raises((EngineKilled, RuntimeError, ConnectionError,
+                        OSError)):
+        eng.alive_count()
+
+
+def test_remote_bad_method_and_garbage(server):
+    import socket
+
+    from gol_tpu.wire import recv_msg, send_msg
+
+    s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    send_msg(s, {"method": "NoSuchMethod"})
+    resp, _ = recv_msg(s)
+    assert resp["ok"] is False and "unknown method" in resp["error"]
+    s.close()
+    # garbage bytes must not take the server down
+    s2 = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    s2.sendall(b"\x00\x00\x00\x05notjs")
+    s2.close()
+    eng = RemoteEngine(f"127.0.0.1:{server.port}")
+    assert eng.alive_count()[0] >= 0
