@@ -76,23 +76,30 @@ def main() -> int:
         except FileNotFoundError:
             parity = None
 
+    from gol_tpu.utils.sync import wait
+
     # warmup: compile the timed loop length + smaller chunk
-    sharded_run_turns(cells, args.warmup_turns, mesh).block_until_ready()
-    sharded_run_turns(cells, args.turns, mesh).block_until_ready()
+    wait(sharded_run_turns(cells, args.warmup_turns, mesh))
+    wait(sharded_run_turns(cells, args.turns, mesh))
 
     t0 = time.perf_counter()
     out = sharded_run_turns(cells, args.turns, mesh)
-    out.block_until_ready()
+    wait(out)
     elapsed = time.perf_counter() - t0
 
     cups = args.turns * n * n / elapsed
     print(
         json.dumps(
             {
-                "metric": "cell-updates/sec (512x512 torus)",
+                "metric": f"cell-updates/sec ({n}x{n} torus)",
                 "value": round(cups, 1),
                 "unit": "cell-updates/s",
-                "vs_baseline": round(cups / BASELINE_CUPS, 2),
+                # BASELINE_CUPS is a 512x512-specific estimate of the
+                # reference stack; a ratio against it only means something
+                # on that board.
+                "vs_baseline": round(cups / BASELINE_CUPS, 2)
+                if n == 512
+                else None,
                 "detail": {
                     "size": n,
                     "turns": args.turns,

@@ -48,6 +48,7 @@ from gol_tpu.ops.stencil import alive_count_exact, from_pixels, to_pixels
 from gol_tpu.params import Params
 from gol_tpu.parallel.halo import shard_board, sharded_run_turns
 from gol_tpu.parallel.mesh import make_mesh, resolve_shard_count
+from gol_tpu.utils.sync import wait
 
 # Control-flag wire values (reference Cf.Flag).
 FLAG_PAUSE = 0
@@ -139,7 +140,7 @@ class Engine:
                 k = _next_chunk(chunk, target - self._turn)
                 t0 = time.monotonic()
                 cells = sharded_run_turns(cells, k, mesh, self._rule)
-                cells.block_until_ready()
+                wait(cells)
                 elapsed = time.monotonic() - t0
                 with self._state_lock:
                     self._cells = cells

@@ -1,0 +1,28 @@
+"""Reliable device synchronization.
+
+`jax.Array.block_until_ready()` is a no-op on some experimental platform
+plugins (observed: the 'axon' TPU tunnel returns immediately even while the
+producing computation is still running). Everything in the framework that
+needs a real completion barrier — benchmark timing, the engine's adaptive
+chunk sizing — must therefore go through `wait()`, which additionally
+fetches one element of the array to the host: a host transfer cannot
+complete before the producer computation has.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def wait(x: jax.Array) -> jax.Array:
+    """Block until `x` is fully computed on every device; returns `x`.
+
+    One element is fetched from each addressable shard — a fetch only
+    barriers the device that owns it, so fetching from shard 0 alone would
+    let shards 1..N-1 still be executing when this returns."""
+    x.block_until_ready()
+    for s in x.addressable_shards:
+        d = s.data
+        np.asarray(jax.device_get(d[(0,) * d.ndim]))
+    return x
