@@ -39,8 +39,9 @@ def main() -> int:
     import jax
 
     from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.ops.bitpack import pack, unpack
     from gol_tpu.ops.stencil import from_pixels
-    from gol_tpu.parallel.halo import shard_board, sharded_run_turns
+    from gol_tpu.parallel.halo import select_representation, shard_board
     from gol_tpu.parallel.mesh import make_mesh, resolve_shard_count
 
     n = args.size
@@ -52,7 +53,10 @@ def main() -> int:
 
     n_shards = resolve_shard_count(n, len(jax.devices()))
     mesh = make_mesh(n_shards)
-    cells = shard_board(from_pixels(world), mesh)
+    # Same representation choice as the engine (one shared rule).
+    packed, sharded_run_turns = select_representation(n)
+    cells01 = from_pixels(world)
+    cells = shard_board(pack(cells01) if packed else cells01, mesh)
 
     # correctness gate: alive-count parity vs golden CSV at turn 100
     parity = None
@@ -66,6 +70,8 @@ def main() -> int:
                     for r in csv.DictReader(f)
                 }
             at100 = sharded_run_turns(cells, 100, mesh)
+            if packed:
+                at100 = unpack(at100)
             got = int(np.asarray(at100).sum())
             parity = got == golden[100]
             if not parity:
@@ -107,6 +113,7 @@ def main() -> int:
                     "turns_per_s": round(args.turns / elapsed, 1),
                     "devices": len(jax.devices()),
                     "shards": n_shards,
+                    "packed": packed,
                     "alive_parity_turn100": parity,
                     "baseline_cups_estimate": BASELINE_CUPS,
                 },

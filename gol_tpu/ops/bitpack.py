@@ -1,0 +1,145 @@
+"""Bit-parallel GoL stencil: 32 cells per uint32 lane.
+
+The reference updates one cell at a time with 8 branchy compares
+(`SubServer/distributor.go:119-208`). The uint8 kernel in `ops/stencil.py`
+already vectorizes that; this module goes one level further down the
+hardware: the board is packed 32 cells to a uint32 word, and the
+8-neighbour sum is computed for all 32 cells of a word at once with a
+carry-save adder network of ~40 bitwise ops — ~1.3 ops *per cell* instead
+of ~10, and 1/8th the HBM traffic. On a VPU whose lanes are 32-bit this is
+the densest representation a life-like CA admits.
+
+Layout: a (H, W) board packs to (H, W/32) uint32, LSB-first — column
+c = 32*w + j lives in bit j of word w of its row. Horizontal torus wrap is
+a word-roll along the row; vertical wrap is a row-roll. The rule is
+evaluated bit-sliced on the 4-bit neighbour count (n0..n3), so Conway costs
+5 extra ops and any life-like rule a handful more — results are bit-exact
+with the unpacked kernel for every rule.
+
+The packed path requires W % 32 == 0 (the engine falls back to the uint8
+kernel otherwise, e.g. the 16x16 test board).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+
+WORD_BITS = 32
+_U = jnp.uint32
+
+
+def pack(cells: jax.Array) -> jax.Array:
+    """{0,1} uint8 (..., H, W) → uint32 (..., H, W/32), LSB-first."""
+    w = cells.shape[-1]
+    if w % WORD_BITS != 0:
+        raise ValueError(f"width {w} not a multiple of {WORD_BITS}")
+    lanes = cells.reshape(*cells.shape[:-1], w // WORD_BITS, WORD_BITS)
+    weights = (jnp.uint32(1) << jnp.arange(WORD_BITS, dtype=_U))
+    return jnp.sum(lanes.astype(_U) * weights, axis=-1, dtype=_U)
+
+
+def unpack(packed: jax.Array) -> jax.Array:
+    """uint32 (..., H, Wp) → {0,1} uint8 (..., H, Wp*32)."""
+    shifts = jnp.arange(WORD_BITS, dtype=_U)
+    bits = (packed[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*packed.shape[:-1], packed.shape[-1] * WORD_BITS
+                        ).astype(jnp.uint8)
+
+
+def _shift_west(row: jax.Array) -> jax.Array:
+    """Bitboard of each cell's west (col-1) neighbour, torus wrap."""
+    return (row << 1) | (jnp.roll(row, 1, axis=-1) >> (WORD_BITS - 1))
+
+
+def _shift_east(row: jax.Array) -> jax.Array:
+    """Bitboard of each cell's east (col+1) neighbour, torus wrap."""
+    return (row >> 1) | (jnp.roll(row, -1, axis=-1) << (WORD_BITS - 1))
+
+
+def _full_add(x, y, z):
+    """Bitwise full adder: per-bit x+y+z as (sum, carry)."""
+    xy = x ^ y
+    return xy ^ z, (x & y) | (z & xy)
+
+
+def neighbour_count_bits(above, mid, below):
+    """4-bit bit-sliced 8-neighbour counts (n0, n1, n2, n3) for the cells of
+    `mid`, given the packed rows above and below (already torus-resolved).
+
+    Carry-save network: horizontal triples per row via full adders, then the
+    column sums of the partial bits."""
+    s0a, s1a = _full_add(_shift_west(above), above, _shift_east(above))
+    s0c, s1c = _full_add(_shift_west(below), below, _shift_east(below))
+    w_mid, e_mid = _shift_west(mid), _shift_east(mid)
+    s0b, s1b = w_mid ^ e_mid, w_mid & e_mid
+
+    u0, u1 = _full_add(s0a, s0b, s0c)      # ones column (0..3)
+    v0, v1 = _full_add(s1a, s1b, s1c)      # twos column (0..3)
+    # n = u0 + 2*(u1 + v0) + 4*v1
+    n1 = u1 ^ v0
+    carry2 = u1 & v0
+    n2 = v1 ^ carry2
+    n3 = v1 & carry2
+    return u0, n1, n2, n3
+
+
+def _rule_from_count_bits(mid, n0, n1, n2, n3, rule: LifeLikeRule):
+    if rule.is_conway:
+        # next = n1 & ~n2 & ~n3 & (n0 | alive)
+        return n1 & ~n2 & ~n3 & (n0 | mid)
+    ones = jnp.uint32(0xFFFFFFFF)
+    bits = (n0, n1, n2, n3)
+
+    def eq(k: int) -> jax.Array:
+        m = ones
+        for i, b in enumerate(bits):
+            m &= b if (k >> i) & 1 else ~b
+        return m
+
+    zero = jnp.zeros_like(mid)
+    born = functools.reduce(
+        lambda a, k: a | eq(k), sorted(rule.born), zero)
+    survive = functools.reduce(
+        lambda a, k: a | eq(k), sorted(rule.survive), zero)
+    return (~mid & born) | (mid & survive)
+
+
+def packed_step(packed: jax.Array, rule: LifeLikeRule = CONWAY) -> jax.Array:
+    """One whole-board torus turn on a (H, Wp) uint32 packed board."""
+    above = jnp.roll(packed, 1, axis=-2)
+    below = jnp.roll(packed, -1, axis=-2)
+    n0, n1, n2, n3 = neighbour_count_bits(above, packed, below)
+    return _rule_from_count_bits(packed, n0, n1, n2, n3, rule)
+
+
+@functools.partial(jax.jit, static_argnames=("num_turns", "rule"))
+def packed_run_turns(
+    packed: jax.Array, num_turns: int, rule: LifeLikeRule = CONWAY
+) -> jax.Array:
+    """Advance `num_turns` turns, fully on-device."""
+    if num_turns == 0:
+        return packed
+    def body(p, _):
+        return packed_step(p, rule), None
+    out, _ = lax.scan(body, packed, None, length=num_turns)
+    return out
+
+
+@jax.jit
+def _row_popcounts(packed: jax.Array) -> jax.Array:
+    return jnp.sum(lax.population_count(packed), axis=-1, dtype=jnp.int32)
+
+
+def packed_alive_count(packed: jax.Array) -> int:
+    """Exact alive count of a packed board (per-row popcount reduced
+    on-device, summed in unbounded Python ints — same overflow story as
+    `stencil.alive_count_exact`)."""
+    return int(np.asarray(jax.device_get(_row_popcounts(packed)),
+                          dtype=np.int64).sum())

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+from gol_tpu.ops.bitpack import _rule_from_count_bits, neighbour_count_bits
 from gol_tpu.ops.stencil import apply_rule
 from gol_tpu.parallel.mesh import ROWS_AXIS, board_sharding
 
@@ -45,10 +46,7 @@ def _local_step(local: jax.Array, n_shards: int, rule: LifeLikeRule):
     (src j → dst j+1) and its FIRST row "up" (src j → dst j-1); with n=1 the
     self-permute degenerates to the torus roll.
     """
-    down = [(j, (j + 1) % n_shards) for j in range(n_shards)]
-    up = [(j, (j - 1) % n_shards) for j in range(n_shards)]
-    top_halo = lax.ppermute(local[-1:, :], ROWS_AXIS, down)
-    bot_halo = lax.ppermute(local[:1, :], ROWS_AXIS, up)
+    top_halo, bot_halo = _exchange_row_halos(local, n_shards)
     padded = jnp.concatenate([top_halo, local, bot_halo], axis=0)
     vert = padded[:-2, :] + padded[1:-1, :] + padded[2:, :]
     counts = (
@@ -60,27 +58,31 @@ def _local_step(local: jax.Array, n_shards: int, rule: LifeLikeRule):
     return apply_rule(local, counts, rule)
 
 
-@functools.lru_cache(maxsize=64)
-def _compiled_run(mesh: Mesh, rule: LifeLikeRule):
-    """jitted (cells, num_turns-static) → cells for one mesh+rule."""
+@functools.lru_cache(maxsize=128)
+def _make_compiled_run(mesh: Mesh, rule: LifeLikeRule, local_step):
+    """jitted (board, num_turns-static) → board for one mesh+rule+step.
+
+    `local_step(local, n_shards, rule)` is the per-shard turn function —
+    the uint8 or the bit-packed stencil; everything else (shard_map over
+    the row axis, the on-device `lax.scan` turn loop) is shared."""
     n_shards = mesh.shape[ROWS_AXIS]
     spec = P(ROWS_AXIS, None)
 
     @functools.partial(jax.jit, static_argnames=("num_turns",))
-    def run(cells: jax.Array, num_turns: int) -> jax.Array:
+    def run(board: jax.Array, num_turns: int) -> jax.Array:
         if num_turns == 0:
-            return cells
+            return board
 
         @functools.partial(
             shard_map, mesh=mesh, in_specs=spec, out_specs=spec
         )
         def run_local(local):
             def body(c, _):
-                return _local_step(c, n_shards, rule), None
+                return local_step(c, n_shards, rule), None
             out, _ = lax.scan(body, local, None, length=num_turns)
             return out
 
-        return run_local(cells)
+        return run_local(board)
 
     return run
 
@@ -92,4 +94,50 @@ def sharded_run_turns(
     rule: LifeLikeRule = CONWAY,
 ) -> jax.Array:
     """Advance a row-sharded board `num_turns` turns on the mesh."""
-    return _compiled_run(mesh, rule)(cells, num_turns)
+    return _make_compiled_run(mesh, rule, _local_step)(cells, num_turns)
+
+
+# ----------------------------------------------------------------- packed
+
+def _exchange_row_halos(local: jax.Array, n_shards: int):
+    """(top_halo, bot_halo) rows for this shard via the ppermute ring."""
+    down = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+    up = [(j, (j - 1) % n_shards) for j in range(n_shards)]
+    top = lax.ppermute(local[-1:, :], ROWS_AXIS, down)
+    bot = lax.ppermute(local[:1, :], ROWS_AXIS, up)
+    return top, bot
+
+
+def _packed_local_step(local: jax.Array, n_shards: int, rule: LifeLikeRule):
+    """One turn of one shard of a bit-packed (rows, W/32 uint32) board:
+    identical ring halo exchange as `_local_step`, but each halo row is W/32
+    words (W/8 bytes) instead of W bytes, and the stencil is the carry-save
+    adder network from `ops/bitpack.py`."""
+    top, bot = _exchange_row_halos(local, n_shards)
+    padded = jnp.concatenate([top, local, bot], axis=0)
+    n0, n1, n2, n3 = neighbour_count_bits(
+        padded[:-2, :], local, padded[2:, :]
+    )
+    return _rule_from_count_bits(local, n0, n1, n2, n3, rule)
+
+
+def sharded_packed_run_turns(
+    packed: jax.Array,
+    num_turns: int,
+    mesh: Mesh,
+    rule: LifeLikeRule = CONWAY,
+) -> jax.Array:
+    """Advance a row-sharded bit-packed board `num_turns` turns."""
+    return _make_compiled_run(mesh, rule, _packed_local_step)(
+        packed, num_turns)
+
+
+def select_representation(width: int):
+    """The one place the packed-eligibility rule lives: returns
+    (packed: bool, run_fn) — bit-packed whenever the width is a whole
+    number of 32-bit words, else the uint8 path."""
+    from gol_tpu.ops.bitpack import WORD_BITS
+
+    if width % WORD_BITS == 0:
+        return True, sharded_packed_run_turns
+    return False, sharded_run_turns

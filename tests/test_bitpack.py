@@ -1,0 +1,94 @@
+"""Bit-parallel packed kernel vs the independent numpy oracle and the uint8
+kernel — the packed path must be bit-exact for every rule (SURVEY §7 hard
+part 3 applied to the densest representation)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.models.lifelike import (
+    CONWAY,
+    DAY_AND_NIGHT,
+    HIGHLIFE,
+    SEEDS,
+    LifeLikeRule,
+)
+from gol_tpu.ops.bitpack import (
+    pack,
+    packed_alive_count,
+    packed_run_turns,
+    packed_step,
+    unpack,
+)
+from gol_tpu.ops.reference import run_turns_np, step_np
+from gol_tpu.ops.stencil import run_turns
+
+
+def random_board(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
+def test_pack_unpack_roundtrip():
+    b = random_board(64, 96, seed=3)
+    assert np.array_equal(np.asarray(unpack(pack(b))), b)
+
+
+def test_pack_rejects_bad_width():
+    with pytest.raises(ValueError):
+        pack(random_board(8, 20))
+
+
+def test_pack_bit_order_lsb_first():
+    b = np.zeros((1, 64), dtype=np.uint8)
+    b[0, 0] = 1   # word 0 bit 0
+    b[0, 33] = 1  # word 1 bit 1
+    p = np.asarray(pack(b))
+    assert p[0, 0] == 1 and p[0, 1] == 2
+
+
+@pytest.mark.parametrize("shape", [(32, 32), (48, 64), (7, 96), (1, 32)])
+def test_packed_step_matches_oracle(shape):
+    b = random_board(*shape, seed=shape[0])
+    got = np.asarray(unpack(packed_step(pack(b))))
+    want = step_np(b)
+    assert np.array_equal(got, want)
+
+
+def test_packed_run_turns_matches_oracle_multi():
+    b = random_board(64, 64, seed=9)
+    got = np.asarray(unpack(packed_run_turns(pack(b), 50)))
+    want = run_turns_np(b, 50)
+    assert np.array_equal(got, want)
+
+
+def test_packed_matches_uint8_kernel_512():
+    b = random_board(128, 128, seed=17, density=0.25)
+    got = np.asarray(unpack(packed_run_turns(pack(b), 20)))
+    want = np.asarray(run_turns(b, 20))
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("rule", [HIGHLIFE, DAY_AND_NIGHT, SEEDS,
+                                  LifeLikeRule("B1/S012345678")])
+def test_packed_lifelike_rules_match_unpacked(rule):
+    b = random_board(32, 64, seed=5)
+    got = np.asarray(unpack(packed_run_turns(pack(b), 8, rule)))
+    want = np.asarray(run_turns(b, 8, rule))
+    assert np.array_equal(got, want)
+
+
+def test_packed_alive_count():
+    b = random_board(96, 128, seed=2)
+    assert packed_alive_count(pack(b)) == int(b.sum())
+
+
+def test_glider_translates_on_packed_torus():
+    # A glider must cross word and torus boundaries intact.
+    b = np.zeros((32, 64), dtype=np.uint8)
+    glider = [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]  # (row, col)
+    for r, c in glider:
+        b[r, (c + 29) % 64] = 1  # straddles the word-0/word-1 boundary
+    out = np.asarray(unpack(packed_run_turns(pack(b), 128)))
+    want = run_turns_np(b, 128)
+    assert np.array_equal(out, want)
+    assert out.sum() == 5

@@ -63,3 +63,41 @@ def test_board_sharding_layout():
     sharded = shard_board(board, mesh)
     assert sharded.sharding == board_sharding(mesh)
     np.testing.assert_array_equal(np.asarray(sharded), board)
+
+
+# ------------------------------------------------------------------ packed
+
+from gol_tpu.models.lifelike import HIGHLIFE
+from gol_tpu.ops.bitpack import pack, unpack
+from gol_tpu.parallel.halo import sharded_packed_run_turns
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+@pytest.mark.parametrize("turns", [1, 3, 50])
+def test_sharded_packed_matches_single_device(n_shards, turns):
+    board = random_board(64, 96, seed=n_shards * 10 + turns)
+    mesh = make_mesh(n_shards)
+    sharded = shard_board(pack(board), mesh)
+    got = np.asarray(unpack(sharded_packed_run_turns(sharded, turns, mesh)))
+    want = np.asarray(run_turns(board, turns))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("n_shards", [2, 8])
+def test_sharded_packed_single_row_shards(n_shards):
+    board = random_board(n_shards, 64, seed=11)
+    mesh = make_mesh(n_shards)
+    sharded = shard_board(pack(board), mesh)
+    got = np.asarray(unpack(sharded_packed_run_turns(sharded, 5, mesh)))
+    want = np.asarray(run_turns(board, 5))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sharded_packed_lifelike_rule():
+    board = random_board(32, 64, seed=13)
+    mesh = make_mesh(4)
+    sharded = shard_board(pack(board), mesh)
+    got = np.asarray(unpack(
+        sharded_packed_run_turns(sharded, 6, mesh, HIGHLIFE)))
+    want = np.asarray(run_turns(board, 6, HIGHLIFE))
+    np.testing.assert_array_equal(got, want)
