@@ -82,7 +82,12 @@ def neighbour_count_bits(above, mid, below):
 
     u0, u1 = _full_add(s0a, s0b, s0c)      # ones column (0..3)
     v0, v1 = _full_add(s1a, s1b, s1c)      # twos column (0..3)
-    # n = u0 + 2*(u1 + v0) + 4*v1
+    return combine_count_columns(u0, u1, v0, v1)
+
+
+def combine_count_columns(u0, u1, v0, v1):
+    """(ones-sum bits, twos-sum bits) → 4 bit-planes of
+    n = u0 + 2*(u1 + v0) + 4*v1. Shared by the jnp and pallas kernels."""
     n1 = u1 ^ v0
     carry2 = u1 & v0
     n2 = v1 ^ carry2
@@ -90,10 +95,22 @@ def neighbour_count_bits(above, mid, below):
     return u0, n1, n2, n3
 
 
-def _rule_from_count_bits(mid, n0, n1, n2, n3, rule: LifeLikeRule):
+def _rule_from_count_bits(
+    mid, n0, n1, n2, n3, rule: LifeLikeRule, count_offset: int = 0
+):
+    """Apply a life-like rule to bit-sliced neighbour counts.
+
+    `count_offset=0`: (n0..n3) is the plain 8-neighbour count.
+    `count_offset=1`: the count is self-inclusive (neighbours + the cell
+    itself, 0..9, as the pallas kernel's shared-horizontal-sum network
+    produces) — Conway becomes `(n9==3) | (alive & n9==4)` and the survive
+    LUT shifts by one."""
     if rule.is_conway:
-        # next = n1 & ~n2 & ~n3 & (n0 | alive)
-        return n1 & ~n2 & ~n3 & (n0 | mid)
+        if count_offset == 0:
+            # next = n==3 | (alive & n==2)  ⇒  n1 & ~n2 & ~n3 & (n0 | alive)
+            return n1 & ~n2 & ~n3 & (n0 | mid)
+        # next = n9==3 | (alive & n9==4)
+        return ~n3 & ((~n2 & n1 & n0) | (mid & n2 & ~n1 & ~n0))
     ones = jnp.uint32(0xFFFFFFFF)
     bits = (n0, n1, n2, n3)
 
@@ -107,7 +124,7 @@ def _rule_from_count_bits(mid, n0, n1, n2, n3, rule: LifeLikeRule):
     born = functools.reduce(
         lambda a, k: a | eq(k), sorted(rule.born), zero)
     survive = functools.reduce(
-        lambda a, k: a | eq(k), sorted(rule.survive), zero)
+        lambda a, k: a | eq(k + count_offset), sorted(rule.survive), zero)
     return (~mid & born) | (mid & survive)
 
 

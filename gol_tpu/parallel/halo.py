@@ -27,7 +27,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
-from gol_tpu.ops.bitpack import _rule_from_count_bits, neighbour_count_bits
+from gol_tpu.ops.bitpack import (
+    WORD_BITS,
+    _rule_from_count_bits,
+    neighbour_count_bits,
+)
 from gol_tpu.ops.stencil import apply_rule
 from gol_tpu.parallel.mesh import ROWS_AXIS, board_sharding
 
@@ -121,6 +125,24 @@ def _packed_local_step(local: jax.Array, n_shards: int, rule: LifeLikeRule):
     return _rule_from_count_bits(local, n0, n1, n2, n3, rule)
 
 
+def _single_device_packed_run(
+    packed: jax.Array, num_turns: int, rule: LifeLikeRule
+) -> jax.Array:
+    """1-shard fast path: the multi-turn VMEM-resident pallas kernel on TPU
+    when the board fits, else the jnp packed scan — no shard_map wrapper."""
+    from gol_tpu.ops.bitpack import packed_run_turns
+    from gol_tpu.ops.pallas_stencil import (
+        fits_in_vmem,
+        pallas_packed_run_turns,
+    )
+
+    devices = getattr(packed, "devices", None)
+    dev = next(iter(devices())) if devices else jax.devices()[0]
+    if dev.platform == "tpu" and fits_in_vmem(packed.shape):
+        return pallas_packed_run_turns(packed, num_turns, rule)
+    return packed_run_turns(packed, num_turns, rule)
+
+
 def sharded_packed_run_turns(
     packed: jax.Array,
     num_turns: int,
@@ -128,6 +150,8 @@ def sharded_packed_run_turns(
     rule: LifeLikeRule = CONWAY,
 ) -> jax.Array:
     """Advance a row-sharded bit-packed board `num_turns` turns."""
+    if mesh.size == 1:
+        return _single_device_packed_run(packed, num_turns, rule)
     return _make_compiled_run(mesh, rule, _packed_local_step)(
         packed, num_turns)
 
@@ -136,8 +160,6 @@ def select_representation(width: int):
     """The one place the packed-eligibility rule lives: returns
     (packed: bool, run_fn) — bit-packed whenever the width is a whole
     number of 32-bit words, else the uint8 path."""
-    from gol_tpu.ops.bitpack import WORD_BITS
-
     if width % WORD_BITS == 0:
         return True, sharded_packed_run_turns
     return False, sharded_run_turns

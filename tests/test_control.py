@@ -118,12 +118,17 @@ def test_detach_and_resume_matches_uninterrupted(
 ):
     """q-detach then CONT=yes reattach must produce exactly the board an
     uninterrupted run produces (determinism makes this checkable)."""
+    # Throttle the engine's chunk growth: the packed kernel advances so many
+    # turns per second that an unthrottled 1.5 s free-run would make the
+    # numpy-oracle replay below take minutes.
+    import gol_tpu.engine as engine_mod
+    monkeypatch.setattr(engine_mod, "MAX_CHUNK", 8)
     engine = Engine()
     p = Params(threads=1, image_width=64, image_height=64, turns=10**8)
     events_q, keys = queue.Queue(), queue.Queue()
     run(p, events_q, keys, engine=engine,
         images_dir=images_dir, out_dir=out_dir)
-    time.sleep(1.5)
+    time.sleep(0.75)
     keys.put("q")
     evs = _drain_to_close(events_q)
     final1 = [e for e in evs if isinstance(e, ev.FinalTurnComplete)][0]
