@@ -45,18 +45,25 @@ def main() -> int:
     from gol_tpu.parallel.mesh import make_mesh, resolve_shard_count
 
     n = args.size
-    try:
-        world = read_pgm(f"images/{n}x{n}.pgm")
-    except (FileNotFoundError, ValueError):
-        rng = np.random.default_rng(0)
-        world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
-
     n_shards = resolve_shard_count(n, len(jax.devices()))
     mesh = make_mesh(n_shards)
     # Same representation choice as the engine (one shared rule).
     packed, sharded_run_turns = select_representation(n)
-    cells01 = from_pixels(world)
-    cells = shard_board(pack(cells01) if packed else cells01, mesh)
+    if packed and n >= 16384:
+        # Giant boards: generate the packed words directly — an (n, n)
+        # uint8 pixel board would need n²/2^30 GB of host RAM first.
+        rng = np.random.default_rng(0)
+        words = rng.integers(
+            0, 2**32, size=(n, n // 32), dtype=np.uint32)
+        cells = shard_board(jax.numpy.asarray(words), mesh)
+    else:
+        try:
+            world = read_pgm(f"images/{n}x{n}.pgm")
+        except (FileNotFoundError, ValueError):
+            rng = np.random.default_rng(0)
+            world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
+        cells01 = from_pixels(world)
+        cells = shard_board(pack(cells01) if packed else cells01, mesh)
 
     # correctness gate: alive-count parity vs golden CSV at turn 100
     parity = None

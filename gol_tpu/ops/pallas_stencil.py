@@ -66,24 +66,37 @@ def fits_in_vmem(shape, itemsize: int = 4) -> bool:
     return h * wp * itemsize <= VMEM_BOARD_BYTES
 
 
-def _step_transposed(t: jax.Array, rule: LifeLikeRule) -> jax.Array:
-    """One torus turn on a transposed packed board t of shape (Wp, H):
-    axis 0 = words of a row (horizontal), axis 1 = board rows (vertical).
+def _step_shared_sums(
+    p: jax.Array, rule: LifeLikeRule, word_axis: int, row_axis: int
+) -> jax.Array:
+    """One torus turn with the shared-horizontal-sum network, for any
+    placement of the word (horizontal) and row (vertical) axes.
 
     Self-inclusive 9-cell count: hs = west + self + east per cell (bit pair
     hs0/hs1), then the vertical full-adder over (row-1, row, row+1) of hs
     gives n9 = n8 + self in 4 bit-planes."""
     shift = WORD_BITS - 1
-    west = (t << 1) | (jnp.roll(t, 1, axis=0) >> shift)
-    east = (t >> 1) | (jnp.roll(t, -1, axis=0) << shift)
-    hs0, hs1 = _full_add(west, t, east)
-
-    u0, u1 = _full_add(jnp.roll(hs0, 1, axis=1), hs0,
-                       jnp.roll(hs0, -1, axis=1))
-    v0, v1 = _full_add(jnp.roll(hs1, 1, axis=1), hs1,
-                       jnp.roll(hs1, -1, axis=1))
+    west = (p << 1) | (jnp.roll(p, 1, axis=word_axis) >> shift)
+    east = (p >> 1) | (jnp.roll(p, -1, axis=word_axis) << shift)
+    hs0, hs1 = _full_add(west, p, east)
+    u0, u1 = _full_add(jnp.roll(hs0, 1, axis=row_axis), hs0,
+                       jnp.roll(hs0, -1, axis=row_axis))
+    v0, v1 = _full_add(jnp.roll(hs1, 1, axis=row_axis), hs1,
+                       jnp.roll(hs1, -1, axis=row_axis))
     n0, n1, n2, n3 = combine_count_columns(u0, u1, v0, v1)
-    return _rule_from_count_bits(t, n0, n1, n2, n3, rule, count_offset=1)
+    return _rule_from_count_bits(p, n0, n1, n2, n3, rule, count_offset=1)
+
+
+def _step_transposed(t: jax.Array, rule: LifeLikeRule) -> jax.Array:
+    """One turn on a transposed (Wp, H) board — words on sublanes, rows on
+    lanes (VMEM-resident kernel for narrow boards)."""
+    return _step_shared_sums(t, rule, word_axis=0, row_axis=1)
+
+
+def _step_rows_cols(p: jax.Array, rule: LifeLikeRule) -> jax.Array:
+    """One turn in the natural (H, Wp) layout, used where the word axis is
+    already wide enough to fill the 128 vector lanes (banded kernel)."""
+    return _step_shared_sums(p, rule, word_axis=-1, row_axis=-2)
 
 
 def _make_kernel(num_turns: int, rule: LifeLikeRule):
@@ -94,6 +107,139 @@ def _make_kernel(num_turns: int, rule: LifeLikeRule):
             0, num_turns, body, in_ref[:].T
         ).T
     return kernel
+
+
+# ------------------------------------------------------------------ banded
+#
+# Boards too big for VMEM: grid over row-bands. Each grid program DMAs its
+# band plus a T-row halo on each side from HBM into VMEM scratch, advances
+# the window T turns locally (the window's vertical wrap corrupts one edge
+# row per turn — after T turns the corruption has consumed exactly the
+# halos and the band itself is exact), and writes the band. HBM traffic per
+# T turns: (1 + 2T/B) reads + 1 write of the board, instead of ~10
+# materialised intermediates per single turn on the jnp path. All programs
+# read the unchanged input board, so bands race-freely share it.
+
+BAND_T = 16  # turns per banded pass == halo depth
+
+
+def _band_rows(height: int, wp: int) -> int:
+    """Largest 8-aligned divisor of `height` whose (B + 2*BAND_T, wp)
+    window fits the VMEM board budget; 0 if none exists or if the word
+    axis is not 128-lane aligned (a Mosaic DMA slice requirement)."""
+    if wp % 128 != 0:
+        return 0
+    max_b = VMEM_BOARD_BYTES // (wp * 4) - 2 * BAND_T
+    b = 0
+    for cand in range(8, max_b + 1, 8):
+        if height % cand == 0:
+            b = cand
+    return b
+
+
+def _make_banded_kernel(
+    band: int, halo_t: int, height: int, rule: LifeLikeRule
+):
+    def kernel(in_hbm, out_ref, scratch, sems):
+        i = pl.program_id(0)
+        # band and halo_t are multiples of 8; rem() obscures that from the
+        # Mosaic alignment prover, so re-assert it.
+        start = pl.multiple_of(i * band, 8)
+        top = pl.multiple_of(
+            lax.rem(start - halo_t + height, height), 8)
+        bot = pl.multiple_of(lax.rem(start + band, height), 8)
+        # Three contiguous pieces: the wrap only ever happens at a piece
+        # boundary (first band's top halo = last rows; last band's bottom
+        # halo = first rows), never inside a piece.
+        copies = [
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(top, halo_t)],
+                scratch.at[pl.ds(0, halo_t)], sems.at[0]),
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(start, band)],
+                scratch.at[pl.ds(halo_t, band)], sems.at[1]),
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(bot, halo_t)],
+                scratch.at[pl.ds(halo_t + band, halo_t)], sems.at[2]),
+        ]
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+
+        def body(_, w):
+            return _step_rows_cols(w, rule)
+        w = lax.fori_loop(0, halo_t, body, scratch[:])
+        out_ref[:] = w[halo_t:halo_t + band]
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("halo_t", "rule", "interpret")
+)
+def _banded_pass(
+    packed: jax.Array,
+    halo_t: int,
+    rule: LifeLikeRule = CONWAY,
+    interpret: bool = False,
+) -> jax.Array:
+    """Advance a big packed board `halo_t` turns in one banded sweep."""
+    height, wp = packed.shape
+    band = _band_rows(height, wp)
+    if band == 0:
+        raise ValueError(
+            f"no viable band size for board {packed.shape}")
+    return pl.pallas_call(
+        _make_banded_kernel(band, halo_t, height, rule),
+        grid=(height // band,),
+        out_shape=jax.ShapeDtypeStruct(packed.shape, packed.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (band, wp), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((band + 2 * halo_t, wp), jnp.uint32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT_BYTES
+        ),
+        interpret=interpret,
+    )(packed)
+
+
+def banded_supported(shape) -> bool:
+    return _band_rows(shape[-2], shape[-1]) > 0
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_turns", "rule", "interpret")
+)
+def banded_packed_run_turns(
+    packed: jax.Array,
+    num_turns: int,
+    rule: LifeLikeRule = CONWAY,
+    interpret: bool = False,
+) -> jax.Array:
+    """Advance a VMEM-oversized packed board `num_turns` turns by repeated
+    banded BAND_T-turn sweeps. A remainder that is itself a multiple of 8
+    runs as one shallower banded sweep; any other remainder falls back to
+    the jnp packed scan (Mosaic DMA offsets must stay 8-sublane-aligned,
+    so halo depths that are not multiples of 8 cannot be swept)."""
+    from gol_tpu.ops.bitpack import packed_run_turns
+
+    full, rem = divmod(num_turns, BAND_T)
+    p = packed
+    if full:
+        def body(c, _):
+            return _banded_pass(c, BAND_T, rule, interpret), None
+        p, _ = lax.scan(body, p, None, length=full)
+    if rem:
+        if rem % 8 == 0:
+            p = _banded_pass(p, rem, rule, interpret)
+        else:
+            p = packed_run_turns(p, rem, rule)
+    return p
 
 
 @functools.partial(

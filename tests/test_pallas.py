@@ -53,3 +53,54 @@ def test_fits_in_vmem_gate():
     assert fits_in_vmem((5120, 160))
     too_big_rows = VMEM_BOARD_BYTES // (2048 * 4) + 1
     assert not fits_in_vmem((too_big_rows, 2048))
+
+
+# ------------------------------------------------------------------ banded
+
+from gol_tpu.ops.bitpack import packed_run_turns
+from gol_tpu.ops.pallas_stencil import (
+    BAND_T,
+    _band_rows,
+    banded_packed_run_turns,
+    banded_supported,
+)
+
+
+def test_band_rows_policy():
+    assert _band_rows(64, 100) == 0          # word axis not lane-aligned
+    assert _band_rows(4096, 128) > 0
+    assert _band_rows(4096, 128) % 8 == 0
+    assert 4096 % _band_rows(4096, 128) == 0
+    assert banded_supported((4096, 128))
+    assert not banded_supported((512, 16))   # 512x512 board: too narrow
+
+
+def test_banded_interpret_matches_jnp():
+    # Smallest banded-eligible board: 4096 wide (wp=128), short.
+    rng = np.random.default_rng(31)
+    b = (rng.random((64, 4096)) < 0.3).astype(np.uint8)
+    p = pack(b)
+    got = np.asarray(banded_packed_run_turns(p, BAND_T, interpret=True))
+    want = np.asarray(packed_run_turns(p, BAND_T))
+    assert np.array_equal(got, want)
+
+
+def test_banded_interpret_remainder_turns():
+    # 20 = BAND_T + 4: one banded sweep plus the jnp remainder fallback.
+    rng = np.random.default_rng(33)
+    b = (rng.random((64, 4096)) < 0.3).astype(np.uint8)
+    p = pack(b)
+    got = np.asarray(
+        banded_packed_run_turns(p, BAND_T + 4, interpret=True))
+    want = np.asarray(packed_run_turns(p, BAND_T + 4))
+    assert np.array_equal(got, want)
+
+
+def test_banded_interpret_lifelike_rule():
+    rng = np.random.default_rng(35)
+    b = (rng.random((64, 4096)) < 0.3).astype(np.uint8)
+    p = pack(b)
+    got = np.asarray(
+        banded_packed_run_turns(p, BAND_T, HIGHLIFE, interpret=True))
+    want = np.asarray(packed_run_turns(p, BAND_T, HIGHLIFE))
+    assert np.array_equal(got, want)

@@ -101,3 +101,55 @@ def test_sharded_packed_lifelike_rule():
         sharded_packed_run_turns(sharded, 6, mesh, HIGHLIFE)))
     want = np.asarray(run_turns(board, 6, HIGHLIFE))
     np.testing.assert_array_equal(got, want)
+
+
+# --------------------------------------------------------------- deep halo
+
+from gol_tpu.models.lifelike import CONWAY
+from gol_tpu.parallel.halo import (
+    _deep_halo_T,
+    _make_compiled_deep_run,
+    DEEP_HALO_T,
+)
+
+
+def test_deep_halo_T_policy():
+    assert _deep_halo_T(64, 512) == 16   # capped by DEEP_HALO_T
+    assert _deep_halo_T(64, 4) == 4      # capped by shard height
+    assert _deep_halo_T(100, 512) == 4   # largest 2^k dividing 100
+    assert _deep_halo_T(7, 512) == 1     # odd turn count: per-turn path
+    assert _deep_halo_T(0, 512) == DEEP_HALO_T
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+@pytest.mark.parametrize("turns", [16, 48, 100])
+def test_deep_halo_matches_single_device(n_shards, turns):
+    # turns chosen so T > 1 kicks in (macro-stepping path).
+    board = random_board(64, 96, seed=n_shards + turns)
+    mesh = make_mesh(n_shards)
+    sharded = shard_board(pack(board), mesh)
+    got = np.asarray(unpack(sharded_packed_run_turns(sharded, turns, mesh)))
+    want = np.asarray(run_turns(board, turns))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_deep_halo_T_equals_shard_rows():
+    # Shards of 4 rows with T=4: the whole shard is sent as halo.
+    board = random_board(16, 64, seed=21)
+    mesh = make_mesh(4)
+    sharded = shard_board(pack(board), mesh)
+    got = np.asarray(unpack(sharded_packed_run_turns(sharded, 8, mesh)))
+    want = np.asarray(run_turns(board, 8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_deep_halo_pallas_interpret_inner():
+    # Exercise the pallas kernel as the per-shard inner engine (interpret
+    # mode on CPU) — the exact composition the TPU multi-chip path uses.
+    board = random_board(32, 64, seed=23)
+    mesh = make_mesh(4)
+    sharded = shard_board(pack(board), mesh)
+    run = _make_compiled_deep_run(mesh, CONWAY, 4, "pallas-interpret")
+    got = np.asarray(unpack(run(sharded, 3)))  # 3 macros x 4 turns
+    want = np.asarray(run_turns(board, 12))
+    np.testing.assert_array_equal(got, want)
