@@ -58,6 +58,11 @@ def read_pgm(path: str) -> np.ndarray:
     this one tokenizes the header properly and then takes exactly W*H
     payload bytes after the single whitespace byte that ends the header.
     """
+    from gol_tpu import native
+
+    board = native.read_pgm(path)  # single-pass C++ codec when built
+    if board is not None:
+        return board
     with open(path, "rb") as f:
         buf = f.read()
     magic, pos = _read_token(buf, 0)
@@ -88,8 +93,12 @@ def write_pgm(path: str, board: np.ndarray) -> None:
     if board.dtype != np.uint8 or board.ndim != 2:
         raise ValueError(f"board must be 2-D uint8, got {board.dtype} "
                          f"shape {board.shape}")
+    from gol_tpu import native
+
     height, width = board.shape
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if native.write_pgm(path, board):
+        return
     with open(path, "wb") as f:
         f.write(MAGIC + b"\n")
         f.write(f"{width} {height}\n".encode())

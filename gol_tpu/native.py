@@ -1,0 +1,206 @@
+"""ctypes binding for the native C++ runtime layer (csrc/golnative.cpp).
+
+Loading is lazy and failure-tolerant: `lib()` returns the loaded library
+or None, and every wrapper has a documented pure-Python/numpy fallback at
+its call site — the framework is fully functional without the .so, the
+native layer just makes the host-side data plane (PGM codec, bit packing,
+frame rendering, CPU stepping) faster. `ensure_built()` compiles the
+single translation unit with the in-repo Makefile when a toolchain is
+available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+_LIB_PATH = _REPO_ROOT / "build" / "libgolnative.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+_i64 = ctypes.c_int64
+_u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
+_u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Build build/libgolnative.so via csrc/Makefile if it doesn't exist.
+    Returns True when the library is present afterwards."""
+    if _LIB_PATH.exists():
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", str(_REPO_ROOT / "csrc")],
+            check=True,
+            capture_output=quiet,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return False
+    return _LIB_PATH.exists()
+
+
+def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
+    cdll.gol_pgm_read_header.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(_i64), ctypes.POINTER(_i64),
+        ctypes.POINTER(_i64)]
+    cdll.gol_pgm_read_header.restype = ctypes.c_int
+    cdll.gol_pgm_read_payload.argtypes = [
+        ctypes.c_char_p, _i64, _u8p, _i64]
+    cdll.gol_pgm_read_payload.restype = ctypes.c_int
+    cdll.gol_pgm_write.argtypes = [ctypes.c_char_p, _u8p, _i64, _i64]
+    cdll.gol_pgm_write.restype = ctypes.c_int
+    cdll.gol_pack_bits.argtypes = [_u8p, _u32p, _i64, _i64]
+    cdll.gol_pack_bits.restype = None
+    cdll.gol_unpack_bits.argtypes = [_u32p, _u8p, _i64, _i64]
+    cdll.gol_unpack_bits.restype = None
+    cdll.gol_popcount_words.argtypes = [_u32p, _i64]
+    cdll.gol_popcount_words.restype = _i64
+    cdll.gol_render_halfblocks.argtypes = [
+        _u8p, _i64, _i64, ctypes.c_char_p, _i64]
+    cdll.gol_render_halfblocks.restype = _i64
+    cdll.gol_step_torus_u64.argtypes = [_u64p, _u64p, _i64, _i64]
+    cdll.gol_step_torus_u64.restype = None
+    return cdll
+
+
+def lib(build: bool = False) -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when unavailable."""
+    global _lib, _load_attempted
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_attempted and not build:
+            return None
+        _load_attempted = True
+        if not _LIB_PATH.exists() and build:
+            ensure_built()
+        if not _LIB_PATH.exists():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(str(_LIB_PATH)))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# ------------------------------------------------------------- wrappers
+
+def read_pgm(path: str) -> Optional[np.ndarray]:
+    """Native PGM read; None if the library is unavailable. Raises
+    ValueError on malformed input (same contract as io.pgm.read_pgm)."""
+    l = lib()
+    if l is None:
+        return None
+    w, h, off = _i64(), _i64(), _i64()
+    rc = l.gol_pgm_read_header(
+        path.encode(), ctypes.byref(w), ctypes.byref(h), ctypes.byref(off))
+    if rc == -1:
+        raise FileNotFoundError(path)
+    if rc != 0:
+        raise ValueError(f"{path}: bad PGM header (native rc {rc})")
+    board = np.empty((h.value, w.value), dtype=np.uint8)
+    rc = l.gol_pgm_read_payload(
+        path.encode(), off.value, board, w.value * h.value)
+    if rc == -21:
+        raise ValueError(f"{path}: payload cells not in {{0, 255}}")
+    if rc != 0:
+        raise ValueError(f"{path}: bad PGM payload (native rc {rc})")
+    return board
+
+
+def write_pgm(path: str, board: np.ndarray) -> bool:
+    """Native PGM write; False if the library is unavailable."""
+    l = lib()
+    if l is None:
+        return False
+    board = np.ascontiguousarray(board, dtype=np.uint8)
+    h, w = board.shape
+    rc = l.gol_pgm_write(path.encode(), board, w, h)
+    if rc != 0:
+        raise OSError(f"{path}: native PGM write failed (rc {rc})")
+    return True
+
+
+def pack_bits(pixels: np.ndarray) -> Optional[np.ndarray]:
+    l = lib()
+    if l is None:
+        return None
+    pixels = np.ascontiguousarray(pixels, dtype=np.uint8)
+    h, w = pixels.shape
+    if w % 32 != 0:
+        raise ValueError(f"width {w} not a multiple of 32")
+    words = np.empty((h, w // 32), dtype=np.uint32)
+    l.gol_pack_bits(pixels, words, h, w)
+    return words
+
+
+def unpack_bits(words: np.ndarray) -> Optional[np.ndarray]:
+    l = lib()
+    if l is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    h, wp = words.shape
+    pixels = np.empty((h, wp * 32), dtype=np.uint8)
+    l.gol_unpack_bits(words, pixels, h, wp * 32)
+    return pixels
+
+
+def popcount(words: np.ndarray) -> Optional[int]:
+    l = lib()
+    if l is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    return int(l.gol_popcount_words(words, words.size))
+
+
+def render_halfblocks(pixels: np.ndarray) -> Optional[str]:
+    """UTF-8 half-block frame of a {0,255} board; None if unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    pixels = np.ascontiguousarray(pixels, dtype=np.uint8)
+    h, w = pixels.shape
+    cap = (3 * w + 1) * ((h + 1) // 2) + 1
+    buf = ctypes.create_string_buffer(cap)
+    n = l.gol_render_halfblocks(pixels, h, w, buf, cap)
+    if n < 0:
+        raise RuntimeError("render buffer too small")
+    return buf.raw[:n].decode("utf-8")
+
+
+def step_torus(cells01: np.ndarray, num_turns: int = 1) -> Optional[np.ndarray]:
+    """Conway turns on a {0,1} board via the native uint64 bit-parallel
+    stepper; None if unavailable. Width must be a multiple of 64."""
+    l = lib()
+    if l is None:
+        return None
+    h, w = cells01.shape
+    if w % 64 != 0:
+        raise ValueError(f"width {w} not a multiple of 64")
+    packed = np.packbits(
+        np.ascontiguousarray(cells01, dtype=np.uint8),
+        axis=1, bitorder="little",
+    ).view(np.uint64).reshape(h, w // 64)
+    cur = np.ascontiguousarray(packed)
+    nxt = np.empty_like(cur)
+    for _ in range(num_turns):
+        l.gol_step_torus_u64(cur, nxt, h, w // 64)
+        cur, nxt = nxt, cur
+    return np.unpackbits(
+        cur.reshape(h, -1).view(np.uint8), axis=1, bitorder="little"
+    )[:, :w]

@@ -126,12 +126,17 @@ BAND_T = 16  # turns per banded pass == halo depth
 def _band_rows(height: int, wp: int) -> int:
     """Largest 8-aligned divisor of `height` whose (B + 2*BAND_T, wp)
     window fits the VMEM board budget; 0 if none exists or if the word
-    axis is not 128-lane aligned (a Mosaic DMA slice requirement)."""
+    axis is not 128-lane aligned (a Mosaic DMA slice requirement).
+
+    Bands must be at least BAND_T rows: a shorter band would let a halo
+    piece wrap around the torus INSIDE one DMA (the kernel's three-piece
+    copy assumes wraps only happen at piece boundaries) and read out of
+    bounds."""
     if wp % 128 != 0:
         return 0
     max_b = VMEM_BOARD_BYTES // (wp * 4) - 2 * BAND_T
     b = 0
-    for cand in range(8, max_b + 1, 8):
+    for cand in range(BAND_T, max_b + 1, 8):
         if height % cand == 0:
             b = cand
     return b
@@ -237,6 +242,11 @@ def banded_packed_run_turns(
     if rem:
         if rem % 8 == 0:
             p = _banded_pass(p, rem, rule, interpret)
+        elif fits_in_vmem(p.shape):
+            # Small turn counts on VMEM-fitting boards (e.g. the engine's
+            # 1/2/4-turn starting chunks) use the whole-board VMEM kernel
+            # rather than regressing to the HBM-bound jnp scan.
+            p = pallas_packed_run_turns(p, rem, rule, interpret)
         else:
             p = packed_run_turns(p, rem, rule)
     return p

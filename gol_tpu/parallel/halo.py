@@ -103,12 +103,13 @@ def sharded_run_turns(
 
 # ----------------------------------------------------------------- packed
 
-def _exchange_row_halos(local: jax.Array, n_shards: int):
-    """(top_halo, bot_halo) rows for this shard via the ppermute ring."""
+def _exchange_row_halos(local: jax.Array, n_shards: int, depth: int = 1):
+    """(top_halo, bot_halo) — `depth` rows from each ring neighbour via
+    ppermute."""
     down = [(j, (j + 1) % n_shards) for j in range(n_shards)]
     up = [(j, (j - 1) % n_shards) for j in range(n_shards)]
-    top = lax.ppermute(local[-1:, :], ROWS_AXIS, down)
-    bot = lax.ppermute(local[:1, :], ROWS_AXIS, up)
+    top = lax.ppermute(local[-depth:, :], ROWS_AXIS, down)
+    bot = lax.ppermute(local[:depth, :], ROWS_AXIS, up)
     return top, bot
 
 
@@ -164,10 +165,7 @@ def _packed_deep_macro(
     from gol_tpu.ops.bitpack import packed_run_turns
     from gol_tpu.ops.pallas_stencil import pallas_packed_run_turns
 
-    down = [(j, (j + 1) % n_shards) for j in range(n_shards)]
-    up = [(j, (j - 1) % n_shards) for j in range(n_shards)]
-    top = lax.ppermute(local[-T:, :], ROWS_AXIS, down)
-    bot = lax.ppermute(local[:T, :], ROWS_AXIS, up)
+    top, bot = _exchange_row_halos(local, n_shards, depth=T)
     window = jnp.concatenate([top, local, bot], axis=0)
     if inner == "pallas":
         window = pallas_packed_run_turns(window, T, rule)

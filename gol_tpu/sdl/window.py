@@ -74,20 +74,23 @@ class Window:
             self._render_ansi(status)
 
     def _render_ansi(self, status: str) -> None:
-        h, w = self._pixels.shape
+        from gol_tpu import native
+
         max_rows = 48 * 2
         max_cols = 160
         p = self._pixels[:max_rows, :max_cols]
-        if p.shape[0] % 2:
-            p = np.vstack([p, np.zeros((1, p.shape[1]), dtype=bool)])
-        top, bot = p[0::2], p[1::2]
-        glyphs = np.array([" ", "▄", "▀", "█"])
-        frame = "\n".join(
-            "".join(row)
-            for row in glyphs[(top.astype(int) << 1) | bot.astype(int)]
-        )
+        frame = native.render_halfblocks(p.astype(np.uint8) * 255)
+        if frame is None:  # no native library: numpy glyph mapping
+            if p.shape[0] % 2:
+                p = np.vstack([p, np.zeros((1, p.shape[1]), dtype=bool)])
+            top, bot = p[0::2], p[1::2]
+            glyphs = np.array([" ", "▀", "▄", "█"])
+            frame = "\n".join(
+                "".join(row)
+                for row in glyphs[top.astype(int) | (bot.astype(int) << 1)]
+            ) + "\n"
         sys.stdout.write(
-            "\x1b[H\x1b[2J" + frame + "\n" + status + "\n"
+            "\x1b[H\x1b[2J" + frame + status + "\n"
         )
         sys.stdout.flush()
 

@@ -1,0 +1,99 @@
+"""Native C++ layer parity: every native function must agree byte-for-byte
+with its pure-Python/JAX counterpart. Builds the library on demand (single
+translation unit); skips if no toolchain is available."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gol_tpu import native
+from gol_tpu.io.pgm import read_pgm, write_pgm
+from gol_tpu.ops.bitpack import pack, unpack
+from gol_tpu.ops.reference import run_turns_np
+
+pytestmark = pytest.mark.skipif(
+    not native.ensure_built() or native.lib(build=True) is None,
+    reason="native library unavailable (no C++ toolchain)",
+)
+
+
+def random_pixels(h, w, seed=0):
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < 0.3).astype(np.uint8)) * 255
+
+
+def test_pack_bits_matches_jax_layout():
+    px = random_pixels(32, 96)
+    got = native.pack_bits(px)
+    want = np.asarray(pack((px != 0).astype(np.uint8)))
+    assert np.array_equal(got, want)
+
+
+def test_unpack_bits_roundtrip():
+    px = random_pixels(16, 64, seed=3)
+    words = native.pack_bits(px)
+    assert np.array_equal(native.unpack_bits(words), px)
+    assert np.array_equal(
+        np.asarray(unpack(words)) * 255, px)
+
+
+def test_popcount():
+    px = random_pixels(64, 128, seed=5)
+    assert native.popcount(native.pack_bits(px)) == int((px != 0).sum())
+
+
+def test_pgm_roundtrip_and_python_interop(tmp_path):
+    px = random_pixels(24, 40, seed=7)
+    p_native = str(tmp_path / "native.pgm")
+    p_python = str(tmp_path / "python.pgm")
+    assert native.write_pgm(p_native, px)
+    write_pgm(p_python, px)  # dispatches to native; same bytes either way
+    assert np.array_equal(native.read_pgm(p_native), px)
+    assert np.array_equal(read_pgm(p_native), px)
+    assert np.array_equal(read_pgm(p_python), px)
+
+
+def test_native_read_rejects_bad_payload(tmp_path):
+    p = str(tmp_path / "bad.pgm")
+    with open(p, "wb") as f:
+        f.write(b"P5\n4 2\n255\n" + bytes([0, 255, 7, 0, 255, 0, 0, 255]))
+    with pytest.raises(ValueError):
+        native.read_pgm(p)
+
+
+def test_native_read_missing_file():
+    with pytest.raises(FileNotFoundError):
+        native.read_pgm("no/such/file.pgm")
+
+
+def test_step_torus_matches_oracle():
+    b = (np.random.default_rng(9).random((48, 128)) < 0.3).astype(np.uint8)
+    got = native.step_torus(b, 25)
+    want = run_turns_np(b, 25)
+    assert np.array_equal(got, want)
+
+
+def test_step_torus_glider_wraps():
+    b = np.zeros((16, 64), dtype=np.uint8)
+    for r, c in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+        b[r, (c + 62) % 64] = 1  # crosses the word boundary and the torus
+    got = native.step_torus(b, 64 * 4)  # glider period x board wrap
+    want = run_turns_np(b, 64 * 4)
+    assert np.array_equal(got, want)
+    assert got.sum() == 5
+
+
+def test_render_halfblocks():
+    px = np.zeros((4, 6), dtype=np.uint8)
+    px[0, 0] = 255  # top half
+    px[1, 1] = 255  # bottom half
+    px[2, 2] = 255
+    px[3, 2] = 255  # full block
+    s = native.render_halfblocks(px)
+    lines = s.splitlines()
+    assert len(lines) == 2
+    assert lines[0][0] == "▀"
+    assert lines[0][1] == "▄"
+    assert lines[1][2] == "█"
+    assert lines[0][2:] == "    "

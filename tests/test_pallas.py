@@ -73,6 +73,11 @@ def test_band_rows_policy():
     assert 4096 % _band_rows(4096, 128) == 0
     assert banded_supported((4096, 128))
     assert not banded_supported((512, 16))   # 512x512 board: too narrow
+    # A band shorter than the halo depth would wrap inside one DMA piece
+    # and read out of bounds — such heights must be rejected.
+    assert _band_rows(8, 128) == 0
+    assert _band_rows(8168, 128) == 0        # 8*1021; only divisor 8 < 16
+    assert _band_rows(4096, 128) >= BAND_T
 
 
 def test_banded_interpret_matches_jnp():
