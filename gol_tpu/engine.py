@@ -35,6 +35,7 @@ reference's mutex-coherent pair (`Server:131-134,173-183`).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -58,6 +59,11 @@ FLAG_KILL = 5
 
 CHUNK_TARGET_SECONDS = 0.15
 MAX_CHUNK = 1024
+
+# GOL_TRACE=<dir>: dump one jax.profiler trace of a representative chunk
+# per run — the counterpart of the reference's runtime/trace TestTrace
+# artifact (`Local/trace_test.go:19-27`, SURVEY §5).
+TRACE_ENV = "GOL_TRACE"
 
 
 class EngineKilled(RuntimeError):
@@ -141,19 +147,36 @@ class Engine:
         target = start_turn + params.turns
         chunk = 1
         quit_run = False
+        trace_dir = os.environ.get(TRACE_ENV, "")
+        chunks_done = 0
         try:
             while self._turn < target and not quit_run:
                 if self._killed:
                     break
                 k = _next_chunk(chunk, target - self._turn)
-                t0 = time.monotonic()
-                cells = run(cells, k, mesh, self._rule)
-                wait(cells)
-                elapsed = time.monotonic() - t0
+                # Trace the second chunk (first is compile-warmup), or the
+                # first when it is the whole run; the traced result is kept
+                # but its timing is not fed to the chunk adapter (profiler
+                # overhead would skew it).
+                trace_now = bool(trace_dir) and (
+                    chunks_done == 1
+                    or (chunks_done == 0 and k == target - self._turn)
+                )
+                if trace_now:
+                    with jax.profiler.trace(trace_dir):
+                        cells = run(cells, k, mesh, self._rule)
+                        wait(cells)
+                    trace_dir = ""
+                else:
+                    t0 = time.monotonic()
+                    cells = run(cells, k, mesh, self._rule)
+                    wait(cells)
+                    elapsed = time.monotonic() - t0
+                    chunk = self._adapt_chunk(chunk, k, elapsed)
+                chunks_done += 1
                 with self._state_lock:
                     self._cells = cells
                     self._turn += k
-                chunk = self._adapt_chunk(chunk, k, elapsed)
                 if self._turn < target:
                     # Only honour flags while turns remain — a pause landing
                     # with the final chunk must not park a finished run.

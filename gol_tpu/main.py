@@ -35,11 +35,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="no window / terminal rendering, events printed")
     ap.add_argument("--live", action="store_true",
                     help="enable the live board view (polls snapshots)")
+    ap.add_argument("--trace", metavar="DIR", default="",
+                    help="dump one jax.profiler chunk trace to DIR")
     return ap.parse_args(argv)
 
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.trace:
+        import os
+
+        from gol_tpu.engine import TRACE_ENV
+
+        os.environ[TRACE_ENV] = args.trace
     p = Params(
         threads=args.threads,
         image_width=args.width,

@@ -34,10 +34,11 @@ _u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
 
 
 def ensure_built(quiet: bool = True) -> bool:
-    """Build build/libgolnative.so via csrc/Makefile if it doesn't exist.
-    Returns True when the library is present afterwards."""
-    if _LIB_PATH.exists():
-        return True
+    """Build (or freshen) build/libgolnative.so via csrc/Makefile — make's
+    own dependency check makes this a no-op when the .so is newer than the
+    source, and an always-run keeps a stale library from shadowing source
+    edits. Returns True when the library is present afterwards. Note: a
+    library already loaded into this process is not reloaded."""
     try:
         subprocess.run(
             ["make", "-C", str(_REPO_ROOT / "csrc")],
@@ -46,7 +47,7 @@ def ensure_built(quiet: bool = True) -> bool:
             timeout=120,
         )
     except (OSError, subprocess.SubprocessError):
-        return False
+        pass  # no toolchain: fall through — a previous build still counts
     return _LIB_PATH.exists()
 
 

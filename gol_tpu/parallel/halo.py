@@ -103,14 +103,30 @@ def sharded_run_turns(
 
 # ----------------------------------------------------------------- packed
 
-def _exchange_row_halos(local: jax.Array, n_shards: int, depth: int = 1):
-    """(top_halo, bot_halo) — `depth` rows from each ring neighbour via
-    ppermute."""
+def exchange_halos(
+    local: jax.Array,
+    n_shards: int,
+    axis_name: str,
+    depth: int = 1,
+    axis: int = 0,
+):
+    """(low_halo, high_halo) — `depth` slices of `axis` from each ring
+    neighbour along mesh axis `axis_name` via ppermute. The low halo is the
+    neighbour-below-in-index's trailing slice (goes above this shard), the
+    high halo the neighbour-above's leading slice."""
     down = [(j, (j + 1) % n_shards) for j in range(n_shards)]
     up = [(j, (j - 1) % n_shards) for j in range(n_shards)]
-    top = lax.ppermute(local[-depth:, :], ROWS_AXIS, down)
-    bot = lax.ppermute(local[:depth, :], ROWS_AXIS, up)
-    return top, bot
+    size = local.shape[axis]
+    trailing = lax.slice_in_dim(local, size - depth, size, axis=axis)
+    leading = lax.slice_in_dim(local, 0, depth, axis=axis)
+    low = lax.ppermute(trailing, axis_name, down)
+    high = lax.ppermute(leading, axis_name, up)
+    return low, high
+
+
+def _exchange_row_halos(local: jax.Array, n_shards: int, depth: int = 1):
+    """(top_halo, bot_halo) rows via the ppermute ring."""
+    return exchange_halos(local, n_shards, ROWS_AXIS, depth=depth, axis=0)
 
 
 def _packed_local_step(local: jax.Array, n_shards: int, rule: LifeLikeRule):
@@ -205,7 +221,10 @@ def _make_compiled_deep_run(
     return run
 
 
-def _deep_inner_kind(mesh: Mesh, window_shape) -> str:
+def inner_kind(mesh: Mesh, window_shape) -> str:
+    """Per-shard engine for a deep-halo window: the VMEM pallas kernel on
+    TPU when the window fits, else the jnp packed scan. Shared by the 1-D
+    and 2-D deep-halo paths."""
     from gol_tpu.ops.pallas_stencil import fits_in_vmem
 
     platform = mesh.devices.flat[0].platform
@@ -255,7 +274,7 @@ def sharded_packed_run_turns(
     T = _deep_halo_T(num_turns, shard_rows)
     if T > 1:
         window_shape = (shard_rows + 2 * T, packed.shape[-1])
-        inner = _deep_inner_kind(mesh, window_shape)
+        inner = inner_kind(mesh, window_shape)
         run = _make_compiled_deep_run(mesh, rule, T, inner)
         return run(packed, num_turns // T)
     return _make_compiled_run(mesh, rule, _packed_local_step)(

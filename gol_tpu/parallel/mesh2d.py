@@ -1,0 +1,148 @@
+"""2-D device mesh: board sharded over rows AND packed-word columns.
+
+The 1-D row sharding (`parallel/mesh.py`) stops scaling when the shard
+height approaches the halo depth, and its halo volume is O(W) per link.
+For pod-scale boards (65536² over dozens of chips, BASELINE config 4) the
+2-D mesh shards both axes: halo volume per link drops to the shard
+*perimeter*, and the chip count is no longer bounded by the row count.
+
+Packed-word geometry makes the horizontal halo cheap: a deep halo of up to
+32 cells is exactly ONE uint32 word column. Each macro-step exchanges
+T ≤ 32 turns worth of halo — T rows vertically (ppermute along "rows"),
+one word column horizontally (ppermute along "cols", taken from the
+row-extended window so the corners ride along) — then advances T turns
+with zero communication, per the same corruption-front argument as the
+1-D deep-halo path (`parallel/halo.py`): window-edge corruption moves one
+cell per turn and exactly consumes the halo.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+from gol_tpu.ops.bitpack import packed_run_turns
+from gol_tpu.parallel.halo import inner_kind
+
+ROWS_AXIS = "rows"
+COLS_AXIS = "cols"
+
+# T ≤ 32 so one word column covers the horizontal halo; T ≤ shard_rows so
+# the vertical halo comes from the adjacent shard only.
+MAX_T_2D = 16
+
+
+def make_mesh2d(
+    shape: Tuple[int, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(rows_shards, cols_shards) mesh with axes ('rows', 'cols')."""
+    devices = list(devices if devices is not None else jax.devices())
+    r, c = shape
+    if r * c > len(devices):
+        raise ValueError(
+            f"mesh {r}x{c} needs {r * c} devices, have {len(devices)}")
+    grid = np.array(devices[: r * c]).reshape(r, c)
+    return Mesh(grid, (ROWS_AXIS, COLS_AXIS))
+
+
+def board_sharding2d(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(ROWS_AXIS, COLS_AXIS))
+
+
+def shard_board2d(packed: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place a packed (H, Wp) board on the 2-D mesh."""
+    return jax.device_put(packed, board_sharding2d(mesh))
+
+
+def _macro_2d(
+    local: jax.Array,
+    n_rows: int,
+    n_cols: int,
+    rule: LifeLikeRule,
+    T: int,
+    inner: str,
+):
+    """One T-turn macro-step of one (rows, wcols) shard."""
+    from gol_tpu.ops.pallas_stencil import pallas_packed_run_turns
+    from gol_tpu.parallel.halo import exchange_halos
+
+    # Vertical: T rows from the ring neighbours above/below.
+    top, bot = exchange_halos(local, n_rows, ROWS_AXIS, depth=T, axis=0)
+    tall = jnp.concatenate([top, local, bot], axis=0)
+    # Horizontal: one word column from the left/right ring neighbours,
+    # taken from the row-extended window so corners are included.
+    west, east = exchange_halos(tall, n_cols, COLS_AXIS, depth=1, axis=1)
+    window = jnp.concatenate([west, tall, east], axis=1)
+    if inner == "pallas":
+        window = pallas_packed_run_turns(window, T, rule)
+    elif inner == "pallas-interpret":
+        window = pallas_packed_run_turns(window, T, rule, interpret=True)
+    else:
+        window = packed_run_turns(window, T, rule)
+    return window[T:-T, 1:-1]
+
+
+@functools.lru_cache(maxsize=128)
+def _make_compiled_run2d(
+    mesh: Mesh, rule: LifeLikeRule, T: int, inner: str
+):
+    n_rows = mesh.shape[ROWS_AXIS]
+    n_cols = mesh.shape[COLS_AXIS]
+    spec = P(ROWS_AXIS, COLS_AXIS)
+
+    @functools.partial(jax.jit, static_argnames=("num_macros",))
+    def run(packed: jax.Array, num_macros: int) -> jax.Array:
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+        def run_local(local):
+            def body(p, _):
+                return _macro_2d(p, n_rows, n_cols, rule, T, inner), None
+            out, _ = lax.scan(body, local, None, length=num_macros)
+            return out
+
+        return run_local(packed)
+
+    return run
+
+
+def sharded_packed_run_turns_2d(
+    packed: jax.Array,
+    num_turns: int,
+    mesh: Mesh,
+    rule: LifeLikeRule = CONWAY,
+) -> jax.Array:
+    """Advance a 2-D-sharded packed board `num_turns` turns.
+
+    Requirements: mesh axes ('rows', 'cols'); board divisible by the mesh.
+    A single word column per shard is fine — the 32-bit halo word protects
+    up to 32 turns of corruption regardless of shard width. Turn counts
+    are decomposed as full MAX_T_2D macros plus one shallower remainder
+    macro — any T ≥ 1 is valid here, so every count works."""
+    n_rows = mesh.shape[ROWS_AXIS]
+    n_cols = mesh.shape[COLS_AXIS]
+    h, wp = packed.shape
+    if h % n_rows or wp % n_cols:
+        raise ValueError(
+            f"board {packed.shape} not divisible by mesh "
+            f"{n_rows}x{n_cols}")
+    shard_rows, shard_cols = h // n_rows, wp // n_cols
+    T = min(MAX_T_2D, shard_rows)
+    window_shape = (shard_rows + 2 * T, shard_cols + 2)
+    inner = inner_kind(mesh, window_shape)
+    run = _make_compiled_run2d(mesh, rule, T, inner)
+    full, rem = divmod(num_turns, T)
+    out = run(packed, full)
+    if rem:
+        out = _make_compiled_run2d(mesh, rule, rem, inner)(out, 1)
+    return out

@@ -1,0 +1,65 @@
+"""Multi-host (multi-process) engine initialization.
+
+The reference scales across hosts by deploying broker/worker binaries on
+separate AWS nodes wired with net/rpc addresses (`SUB` env,
+`Local/gol/distributor.go:100-105`). The TPU-native equivalent is JAX
+multi-process SPMD: every engine host calls `initialize()` (same program,
+different `process_id`), after which `jax.devices()` spans the whole pod
+and the meshes built by `parallel/mesh.py` / `parallel/mesh2d.py` lay
+shards across hosts — ppermute halos ride ICI within a slice and DCN
+between slices, with no change to any kernel or engine code.
+
+Environment mapping (mirrors the reference's `SER`/`SUB` env config):
+
+    GOL_COORDINATOR   coordinator address host:port (falls back to
+                      JAX_COORDINATOR_ADDRESS; neither set = single-host)
+    GOL_NUM_PROCS     number of engine processes
+    GOL_PROC_ID       this process's id (0-based)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join (or skip joining) the multi-host engine cluster.
+
+    Arguments fall back to GOL_COORDINATOR / GOL_NUM_PROCS / GOL_PROC_ID.
+    Returns True when running multi-process after the call, False when no
+    coordinator is configured (single-host mode — a no-op, the common
+    localhost/test story). Safe to call twice."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = (
+        coordinator_address
+        or os.environ.get("GOL_COORDINATOR", "")
+        or os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    )
+    if not coordinator_address:
+        return False
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("GOL_NUM_PROCS", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("GOL_PROC_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1

@@ -141,3 +141,32 @@ def test_concurrent_run_rejected():
         eng.server_distributor(p, board(16, 16))
     eng.cf_put(FLAG_QUIT)
     t.join(10)
+
+
+def test_trace_dump(tmp_path, monkeypatch, images_dir):
+    """GOL_TRACE must produce a profiler artifact for one chunk (the
+    counterpart of the reference's TestTrace, `Local/trace_test.go`)."""
+    import os
+
+    from gol_tpu.engine import TRACE_ENV
+    from gol_tpu.io.pgm import read_pgm
+
+    trace_dir = str(tmp_path / "trace")
+    monkeypatch.setenv(TRACE_ENV, trace_dir)
+    engine = Engine()
+    world = read_pgm(os.path.join(images_dir, "64x64.pgm"))
+    engine.server_distributor(
+        Params(threads=1, image_width=64, image_height=64, turns=20), world
+    )
+    dumped = []
+    for root, _dirs, files in os.walk(trace_dir):
+        dumped.extend(files)
+    assert dumped, "no profiler trace files written"
+
+
+def test_multihost_noop_without_coordinator(monkeypatch):
+    from gol_tpu.parallel import multihost
+
+    monkeypatch.delenv("GOL_COORDINATOR", raising=False)
+    assert multihost.initialize() is False
+    assert multihost.is_multihost() is False

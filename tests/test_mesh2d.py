@@ -1,0 +1,83 @@
+"""2-D mesh parity: row x word-column sharding with perimeter deep halos
+must be bitwise identical to the single-device kernel for every mesh shape
+and turn count (SURVEY §7 hard part 3, extended to the second axis)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.models.lifelike import CONWAY, HIGHLIFE
+from gol_tpu.ops.bitpack import pack, unpack
+from gol_tpu.ops.stencil import run_turns
+from gol_tpu.parallel.mesh2d import (
+    _make_compiled_run2d,
+    make_mesh2d,
+    shard_board2d,
+    sharded_packed_run_turns_2d,
+)
+
+
+def random_board(h, w, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < 0.3).astype(np.uint8)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2), (2, 2), (8, 1),
+                                        (1, 8)])
+@pytest.mark.parametrize("turns", [1, 16, 37])
+def test_2d_matches_single_device(mesh_shape, turns):
+    board = random_board(64, 256, seed=sum(mesh_shape) * turns)
+    mesh = make_mesh2d(mesh_shape)
+    sharded = shard_board2d(pack(board), mesh)
+    got = np.asarray(unpack(
+        sharded_packed_run_turns_2d(sharded, turns, mesh)))
+    want = np.asarray(run_turns(board, turns))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_2d_single_word_column_shards():
+    # shard_cols == 1: the horizontal halo is the neighbour's only word.
+    board = random_board(32, 128, seed=41)  # wp=4 over 4 column shards
+    mesh = make_mesh2d((2, 4))
+    sharded = shard_board2d(pack(board), mesh)
+    got = np.asarray(unpack(
+        sharded_packed_run_turns_2d(sharded, 20, mesh)))
+    want = np.asarray(run_turns(board, 20))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_2d_shallow_shards():
+    # shard height < MAX_T_2D: T capped by shard height.
+    board = random_board(16, 256, seed=43)  # 8 rows/shard
+    mesh = make_mesh2d((2, 4))
+    sharded = shard_board2d(pack(board), mesh)
+    got = np.asarray(unpack(
+        sharded_packed_run_turns_2d(sharded, 24, mesh)))
+    want = np.asarray(run_turns(board, 24))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_2d_lifelike_rule():
+    board = random_board(32, 256, seed=45)
+    mesh = make_mesh2d((2, 2))
+    sharded = shard_board2d(pack(board), mesh)
+    got = np.asarray(unpack(
+        sharded_packed_run_turns_2d(sharded, 10, mesh, HIGHLIFE)))
+    want = np.asarray(run_turns(board, 10, HIGHLIFE))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_2d_pallas_interpret_inner():
+    board = random_board(32, 128, seed=47)
+    mesh = make_mesh2d((2, 2))
+    sharded = shard_board2d(pack(board), mesh)
+    run = _make_compiled_run2d(mesh, CONWAY, 4, "pallas-interpret")
+    got = np.asarray(unpack(run(sharded, 3)))
+    want = np.asarray(run_turns(board, 12))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_2d_rejects_indivisible():
+    mesh = make_mesh2d((2, 4))
+    board = pack(random_board(30, 128))[:29]  # 29 rows over 2 row shards
+    with pytest.raises(ValueError):
+        sharded_packed_run_turns_2d(board, 4, mesh)
