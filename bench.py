@@ -29,12 +29,53 @@ import numpy as np
 BASELINE_CUPS = 2.6e7  # see module docstring
 
 
+def bench_rpentomino(turns: int) -> int:
+    """BASELINE config 5: R-pentomino on a 2^20 sparse torus — stresses
+    the expanding-window sparse engine + popcount alive reduction."""
+    import time
+
+    from gol_tpu.models.sparse import R_PENTOMINO, SparseTorus
+
+    size = 2**20
+    start = [(x + size // 2, y + size // 2) for x, y in R_PENTOMINO]
+    warm = SparseTorus(size, start)
+    warm.run(turns)  # compile the whole window-size ladder
+    sp = SparseTorus(size, start)
+    t0 = time.perf_counter()
+    sp.run(turns)
+    alive = sp.alive_count()
+    elapsed = time.perf_counter() - t0
+    h, w = sp.window_shape()
+    print(
+        json.dumps(
+            {
+                "metric": f"turns/sec (R-pentomino, 2^20 sparse torus)",
+                "value": round(turns / elapsed, 1),
+                "unit": "turns/s",
+                "vs_baseline": None,
+                "detail": {
+                    "turns": turns,
+                    "elapsed_s": round(elapsed, 4),
+                    "alive": alive,
+                    "window": [h, w],
+                },
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=512)
     ap.add_argument("--turns", type=int, default=2000)
     ap.add_argument("--warmup-turns", type=int, default=128)
+    ap.add_argument(
+        "--pattern", choices=["dense", "rpentomino"], default="dense")
     args = ap.parse_args()
+
+    if args.pattern == "rpentomino":
+        return bench_rpentomino(args.turns)
 
     import jax
 

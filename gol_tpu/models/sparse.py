@@ -1,0 +1,204 @@
+"""Sparse-torus engine: evolve a small pattern on an enormous torus.
+
+BASELINE config 5 is "R-pentomino on a 2^20 sparse torus" — a board of
+2^40 cells (137 GB packed), absurd to materialise when fewer than a few
+thousand cells are ever alive. This engine tracks only the live bounding
+window as a packed board on-device and advances it with the same kernel
+dispatch as the dense engine (`parallel/halo.py:_single_device_packed_run`
+— VMEM pallas kernel, banded kernel, or jnp scan as the window grows).
+
+Correctness argument: the window is stepped with ordinary *torus* stepping.
+As long as every live cell stays at least one row/column inside the window
+margin, the window's wrap-around feeds only dead cells to dead cells —
+identical to the same region embedded in the huge torus. A pattern can
+expand at most one cell per turn, so a macro-step of K turns is exact iff
+the margin before it is ≥ K + 1; `run()` re-measures the live bounding box
+between macro-steps and grows the window (aligned, zero-padded, on-device)
+ahead of need. If the pattern ever spans the full torus dimension the
+window becomes the whole torus and this degenerates to the dense engine
+(for a 2^20 torus that is ~10^5+ turns of sustained growth).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+from gol_tpu.ops.bitpack import (
+    WORD_BITS,
+    pack,
+    packed_alive_count,
+    unpack,
+)
+
+# R-pentomino in (col, row) offsets — the reference-era standard pattern.
+R_PENTOMINO = ((1, 0), (2, 0), (0, 1), (1, 1), (1, 2))
+
+# Coarse alignment ladder: every distinct window shape costs one XLA/pallas
+# compile, so shapes are quantized aggressively and growth overshoots
+# (3x the needed margin) to keep regrowth — and thus recompiles — rare.
+_ROW_ALIGN = 256         # window heights: multiples of 256 rows
+_COL_ALIGN = 2048        # window widths: multiples of 2048 cells
+_WIDE_COL_ALIGN = 4096   # beyond VMEM: 128-lane word alignment for banded
+_GROW_FACTOR = 3
+
+
+@jax.jit
+def _row_occupancy(packed: jax.Array) -> jax.Array:
+    return jnp.sum(lax.population_count(packed), axis=1, dtype=jnp.int32)
+
+
+@jax.jit
+def _col_word_occupancy(packed: jax.Array) -> jax.Array:
+    return jnp.sum(lax.population_count(packed), axis=0, dtype=jnp.int32)
+
+
+def _round_up(v: int, align: int) -> int:
+    return -(-v // align) * align
+
+
+class SparseTorus:
+    """A sparse pattern on an `size` x `size` torus (size % 32 == 0)."""
+
+    def __init__(
+        self,
+        size: int,
+        cells: Iterable[Tuple[int, int]],
+        rule: LifeLikeRule = CONWAY,
+    ) -> None:
+        if size % WORD_BITS != 0:
+            raise ValueError(f"torus size {size} not a multiple of 32")
+        if 0 in rule.born:
+            # A B0 rule births cells in empty space: the whole torus is
+            # active and a live-bounding window is meaningless.
+            raise ValueError(
+                f"rule {rule.rulestring} births on 0 neighbours; "
+                "use the dense engine")
+        self.size = size
+        self.rule = rule
+        self.turn = 0
+        cells = list(cells)
+        if not cells:
+            raise ValueError("need at least one live cell")
+        xs = [c[0] % size for c in cells]
+        ys = [c[1] % size for c in cells]
+        x0, y0 = min(xs), min(ys)
+        w = max(xs) - x0 + 1
+        h = max(ys) - y0 + 1
+        if w > size // 2 or h > size // 2:
+            raise ValueError(
+                "pattern spans most of the torus — use the dense engine")
+        # Initial window with a generous margin, aligned.
+        margin = 64
+        win_w = min(_round_up(w + 2 * margin, _COL_ALIGN), size)
+        win_h = min(_round_up(h + 2 * margin, _ROW_ALIGN), size)
+        # Torus origin of window cell (0, 0); word-aligned columns.
+        self._ox = ((x0 - (win_w - w) // 2) // WORD_BITS * WORD_BITS) % size
+        self._oy = (y0 - (win_h - h) // 2) % size
+        board = np.zeros((win_h, win_w), dtype=np.uint8)
+        for x, y in zip(xs, ys):
+            board[(y - self._oy) % size, (x - self._ox) % size] = 1
+        self._packed = jax.device_put(pack(board))
+
+    # ------------------------------------------------------------- queries
+
+    def alive_count(self) -> int:
+        return packed_alive_count(self._packed)
+
+    def window_shape(self) -> Tuple[int, int]:
+        h, wp = self._packed.shape
+        return h, wp * WORD_BITS
+
+    def alive_cells(self) -> List[Tuple[int, int]]:
+        """Live cells in torus coordinates (col, row), unordered."""
+        dense = np.asarray(unpack(self._packed))
+        ys, xs = np.nonzero(dense)
+        return [
+            (int((x + self._ox) % self.size),
+             int((y + self._oy) % self.size))
+            for x, y in zip(xs, ys)
+        ]
+
+    # ------------------------------------------------------------- bbox
+
+    def _margins(self) -> Optional[Tuple[int, int, int, int]]:
+        """(top, bottom, left, right) dead margins of the window, with
+        column granularity of one 32-bit word; None when no cell lives."""
+        rows = np.asarray(jax.device_get(_row_occupancy(self._packed)))
+        cols = np.asarray(jax.device_get(_col_word_occupancy(self._packed)))
+        live_rows = np.nonzero(rows)[0]
+        live_cols = np.nonzero(cols)[0]
+        if live_rows.size == 0:
+            return None
+        top = int(live_rows[0])
+        bottom = int(self._packed.shape[0] - 1 - live_rows[-1])
+        left = int(live_cols[0]) * WORD_BITS
+        right = (
+            int(self._packed.shape[1] - 1 - live_cols[-1]) * WORD_BITS
+        )
+        return top, bottom, left, right
+
+    def _grow(self, need: int) -> None:
+        """Re-center the live region in a window with ≥ `need` margin on
+        every side (or the full torus if that is reached). Caller ensures
+        the board is non-empty."""
+        top, bottom, left, right = self._margins()
+        h, wp = self._packed.shape
+        w = wp * WORD_BITS
+        live_h = h - top - bottom
+        live_w = w - left - right
+        headroom = _GROW_FACTOR * need + 64
+        # Once the window outgrows one wide-align unit, snap widths to
+        # 4096 cells (wp % 128 == 0) so the banded pallas kernel stays
+        # eligible as the window leaves the VMEM budget.
+        col_align = (
+            _WIDE_COL_ALIGN
+            if live_w + 2 * headroom > _WIDE_COL_ALIGN
+            else _COL_ALIGN
+        )
+        new_h = min(_round_up(live_h + 2 * headroom, _ROW_ALIGN),
+                    self.size)
+        new_w = min(_round_up(live_w + 2 * headroom, col_align),
+                    self.size)
+        pad_top = (new_h - live_h) // 2
+        pad_left_words = ((new_w - live_w) // 2) // WORD_BITS
+        new = jnp.zeros((new_h, new_w // WORD_BITS),
+                        dtype=self._packed.dtype)
+        src = self._packed[top:h - bottom if bottom else h, :]
+        src = src[:, left // WORD_BITS: wp - right // WORD_BITS]
+        new = lax.dynamic_update_slice(
+            new, src, (pad_top, pad_left_words))
+        self._ox = (self._ox + left - pad_left_words * WORD_BITS) \
+            % self.size
+        self._oy = (self._oy + top - pad_top) % self.size
+        self._packed = new
+
+    # ------------------------------------------------------------- stepping
+
+    def run(self, turns: int, macro: int = 256) -> None:
+        """Advance `turns` turns in macro-steps of ≤ `macro`."""
+        from gol_tpu.parallel.halo import _single_device_packed_run
+
+        done = 0
+        while done < turns:
+            k = min(macro, turns - done)
+            h, wp = self._packed.shape
+            full_torus = h >= self.size and wp * WORD_BITS >= self.size
+            if not full_torus:
+                margins = self._margins()
+                if margins is None:
+                    # Pattern died out: with no B0 birth (guarded in
+                    # __init__) an empty board stays empty forever.
+                    self.turn += turns - done
+                    return
+                if min(margins) < k + 1:
+                    self._grow(k + 1)
+            self._packed = _single_device_packed_run(
+                self._packed, k, self.rule)
+            done += k
+            self.turn += k

@@ -84,8 +84,10 @@ def lib(build: bool = False) -> Optional[ctypes.CDLL]:
         if _load_attempted and not build:
             return None
         _load_attempted = True
-        if not _LIB_PATH.exists() and build:
-            ensure_built()
+        # Freshen unconditionally on the first load attempt: make's own
+        # dependency check is a cheap no-op when the .so is current, and
+        # this keeps a stale library from shadowing source edits.
+        ensure_built()
         if not _LIB_PATH.exists():
             return None
         try:

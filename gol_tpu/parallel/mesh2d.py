@@ -31,8 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
 from gol_tpu.ops.bitpack import packed_run_turns
 from gol_tpu.parallel.halo import inner_kind
+from gol_tpu.parallel.mesh import ROWS_AXIS
 
-ROWS_AXIS = "rows"
 COLS_AXIS = "cols"
 
 # T ≤ 32 so one word column covers the horizontal halo; T ≤ shard_rows so

@@ -1,0 +1,89 @@
+"""Sparse-torus engine: windowed evolution on a huge torus must match the
+dense oracle exactly (BASELINE config 5)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.models.sparse import R_PENTOMINO, SparseTorus
+from gol_tpu.ops.reference import run_turns_np
+
+
+def dense_evolve(size, cells, turns):
+    board = np.zeros((size, size), dtype=np.uint8)
+    for x, y in cells:
+        board[y % size, x % size] = 1
+    return run_turns_np(board, turns)
+
+
+def cells_of(board):
+    ys, xs = np.nonzero(board)
+    return {(int(x), int(y)) for x, y in zip(xs, ys)}
+
+
+def test_r_pentomino_matches_dense_oracle():
+    # Same pattern on a small dense torus and a huge sparse torus: while
+    # the pattern is far from the edges both must agree cell-for-cell.
+    size_dense = 256
+    start = [(x + 120, y + 120) for x, y in R_PENTOMINO]
+    turns = 50
+    want = cells_of(dense_evolve(size_dense, start, turns))
+
+    sp = SparseTorus(2**20, start)
+    sp.run(turns, macro=16)
+    got = set(sp.alive_cells())
+    assert got == want
+    assert sp.alive_count() == len(want)
+    assert sp.turn == turns
+
+
+def test_glider_travels_across_window_growth():
+    glider = [(1, 0), (2, 1), (0, 2), (1, 2), (2, 2)]
+    start = [(x + 500, y + 500) for x, y in glider]
+    sp = SparseTorus(2**20, start)
+    sp.run(400, macro=128)  # glider moves (+1,+1) every 4 turns
+    got = set(sp.alive_cells())
+    want = {(x + 100, y + 100) for x, y in start}
+    assert got == want
+    assert sp.alive_count() == 5
+
+
+def test_blinker_window_stays_bounded():
+    blinker = [(100, 100), (101, 100), (102, 100)]
+    sp = SparseTorus(2**20, blinker)
+    sp.run(301, macro=64)
+    h, w = sp.window_shape()
+    assert h <= 2048 and w <= 8192, "static pattern must not grow the window"
+    # Odd turn count: blinker is vertical.
+    assert set(sp.alive_cells()) == {(101, 99), (101, 100), (101, 101)}
+
+
+def test_pattern_near_torus_origin_wraps_coordinates():
+    # Pattern placed at the torus origin: window origin wraps negative.
+    blinker = [(0, 0), (1, 0), (2, 0)]
+    sp = SparseTorus(2**20, blinker)
+    sp.run(2, macro=2)
+    assert set(sp.alive_cells()) == {(0, 0), (1, 0), (2, 0)}
+
+
+def test_rejects_bad_input():
+    with pytest.raises(ValueError):
+        SparseTorus(1000, [(0, 0)])  # size not a multiple of 32
+    with pytest.raises(ValueError):
+        SparseTorus(2**20, [])
+
+
+def test_died_out_pattern_is_stable():
+    # A lone cell dies at turn 1; long runs must not crash or grow.
+    sp = SparseTorus(2**20, [(100, 100)])
+    sp.run(1)
+    sp.run(600, macro=256)  # would previously crash in _grow on empty
+    assert sp.alive_count() == 0
+    assert sp.turn == 601
+    assert sp.alive_cells() == []
+
+
+def test_rejects_b0_rule():
+    from gol_tpu.models.lifelike import LifeLikeRule
+
+    with pytest.raises(ValueError):
+        SparseTorus(2**20, [(0, 0)], LifeLikeRule("B0/S23"))
