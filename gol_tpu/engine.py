@@ -65,6 +65,15 @@ MAX_CHUNK = 1024
 # artifact (`Local/trace_test.go:19-27`, SURVEY §5).
 TRACE_ENV = "GOL_TRACE"
 
+# GOL_CKPT=<dir> [GOL_CKPT_EVERY=<seconds>]: periodic crash-safe
+# checkpoints during a run. The reference has only in-memory state plus
+# user-triggered PGM snapshots (SURVEY §5 "checkpoint/resume"); this adds
+# the crash-safe variant: an atomic .npz of (world, turn) the engine can
+# reload before a CONT=yes reattach.
+CKPT_ENV = "GOL_CKPT"
+CKPT_EVERY_ENV = "GOL_CKPT_EVERY"
+CKPT_EVERY_DEFAULT = 30.0
+
 
 class EngineKilled(RuntimeError):
     """Raised on any call after kill_prog — the in-process stand-in for the
@@ -90,9 +99,20 @@ class Engine:
         self,
         devices: Optional[Sequence[jax.Device]] = None,
         rule: LifeLikeRule = CONWAY,
+        mesh_shape: Optional[Tuple[int, int]] = None,
     ) -> None:
+        """`mesh_shape=(rows, cols)` requests the 2-D mesh (perimeter deep
+        halos, `parallel/mesh2d.py`) instead of 1-D row sharding; it also
+        honours GOL_MESH="RxC" from the environment. The engine falls back
+        to 1-D when the board or device count doesn't fit the request."""
         self._devices = list(devices if devices is not None else jax.devices())
         self._rule = rule
+        if mesh_shape is None:
+            spec = os.environ.get("GOL_MESH", "")
+            if "x" in spec:
+                r, c = spec.lower().split("x", 1)
+                mesh_shape = (int(r), int(c))
+        self._mesh_shape = mesh_shape
         self._state_lock = threading.Lock()
         # Row-sharded board: bit-packed uint32 (H, W/32) whenever the width
         # allows (32 cells/lane, 1/8th the HBM traffic — `ops/bitpack.py`),
@@ -126,16 +146,27 @@ class Engine:
             raise RuntimeError("engine already running a board")
 
         height, width = world.shape
-        # Shard-count request: worker-list length (reference SUB), falling
-        # back to the `threads` hint (reference per-worker fan-out param).
-        requested = len(sub_workers) if sub_workers else params.threads
-        requested = min(requested, len(self._devices))
-        n_shards = resolve_shard_count(height, requested)
-        mesh = make_mesh(n_shards, self._devices)
-
         packed, run = select_representation(width)
         cells01 = from_pixels(world)
-        cells = shard_board(pack(cells01) if packed else cells01, mesh)
+        mesh2d = self._resolve_mesh2d(height, width, packed)
+        if mesh2d is not None:
+            from gol_tpu.parallel.mesh2d import (
+                shard_board2d,
+                sharded_packed_run_turns_2d,
+            )
+
+            mesh = mesh2d
+            run = sharded_packed_run_turns_2d
+            cells = shard_board2d(pack(cells01), mesh)
+        else:
+            # Shard-count request: worker-list length (reference SUB),
+            # falling back to the `threads` hint (per-worker fan-out).
+            requested = len(sub_workers) if sub_workers else params.threads
+            requested = min(requested, len(self._devices))
+            n_shards = resolve_shard_count(height, requested)
+            mesh = make_mesh(n_shards, self._devices)
+            cells = shard_board(
+                pack(cells01) if packed else cells01, mesh)
         with self._state_lock:
             if self._running:  # re-check under the lock (TOCTOU)
                 raise RuntimeError("engine already running a board")
@@ -148,6 +179,14 @@ class Engine:
         chunk = 1
         quit_run = False
         trace_dir = os.environ.get(TRACE_ENV, "")
+        ckpt_dir = os.environ.get(CKPT_ENV, "")
+        ckpt_every = float(
+            os.environ.get(CKPT_EVERY_ENV, CKPT_EVERY_DEFAULT))
+        ckpt_path = ""
+        if ckpt_dir:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            ckpt_path = os.path.join(ckpt_dir, f"{width}x{height}.npz")
+        last_ckpt = time.monotonic()
         chunks_done = 0
         try:
             while self._turn < target and not quit_run:
@@ -177,6 +216,10 @@ class Engine:
                 with self._state_lock:
                     self._cells = cells
                     self._turn += k
+                if ckpt_path and \
+                        time.monotonic() - last_ckpt >= ckpt_every:
+                    self.save_checkpoint(ckpt_path)
+                    last_ckpt = time.monotonic()
                 if self._turn < target:
                     # Only honour flags while turns remain — a pause landing
                     # with the final chunk must not park a finished run.
@@ -230,7 +273,53 @@ class Engine:
         """Mark the engine dead (ref `Server:77-80`, worker os.Exit)."""
         self._killed = True
 
+    # -------------------------------------------------------- checkpointing
+
+    def save_checkpoint(self, path: str) -> None:
+        """Atomically write (world, turn) as a compressed .npz."""
+        world, turn = self._snapshot()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, world=world, turn=turn)
+        os.replace(tmp, path)
+
+    def load_checkpoint(self, path: str) -> int:
+        """Restore (world, turn) from a checkpoint; returns the turn.
+        The restored state serves `get_world`/`alive_count` immediately,
+        so a controller can reattach with CONT=yes as if the engine had
+        never died."""
+        self._check_alive()
+        with np.load(path) as z:
+            world = z["world"]
+            turn = int(z["turn"])
+        height, width = world.shape
+        packed, _ = select_representation(width)
+        cells01 = from_pixels(world)
+        cells = pack(cells01) if packed else jax.device_put(cells01)
+        with self._state_lock:
+            if self._running:
+                raise RuntimeError("cannot restore while running")
+            self._cells = cells
+            self._packed = packed
+            self._turn = turn
+        return turn
+
     # ------------------------------------------------------------- internals
+
+    def _resolve_mesh2d(self, height: int, width: int, packed: bool):
+        """The requested 2-D mesh, or None to use 1-D row sharding (no
+        request, unpacked board, or a request the board/devices can't
+        satisfy)."""
+        if self._mesh_shape is None or not packed:
+            return None
+        from gol_tpu.ops.bitpack import WORD_BITS
+        from gol_tpu.parallel.mesh2d import make_mesh2d
+
+        r, c = self._mesh_shape
+        wp = width // WORD_BITS
+        if r * c > len(self._devices) or height % r or wp % c:
+            return None
+        return make_mesh2d((r, c), self._devices)
 
     def _check_alive(self) -> None:
         if self._killed:

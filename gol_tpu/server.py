@@ -122,8 +122,14 @@ def main() -> None:
     ap.add_argument("--port", type=int,
                     default=int(os.environ.get("GOL_PORT", DEFAULT_PORT)))
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--resume", metavar="CKPT", default="",
+                    help="restore (world, turn) from a checkpoint .npz "
+                         "before serving (pairs with GOL_CKPT autosaves)")
     args = ap.parse_args()
     srv = EngineServer(port=args.port, host=args.host)
+    if args.resume:
+        turn = srv.engine.load_checkpoint(args.resume)
+        print(f"restored checkpoint {args.resume} at turn {turn}")
     print(f"gol_tpu engine serving on :{srv.port} "
           f"({len(np.atleast_1d(srv.engine._devices))} device(s))")
     srv.serve_forever()
