@@ -29,17 +29,29 @@ namespace {
 
 constexpr int kMaxval = 255;
 
-int read_all(const char* path, std::string* out) {
+// Read at most `cap` leading bytes — the header tokenizer never needs the
+// payload, and slurping a 65536² file (4.3 GB) just to parse a ~20-byte
+// header would defeat the codec's single-pass design.
+int read_prefix(const char* path, size_t cap, std::string* out) {
   std::FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
-  std::fseek(f, 0, SEEK_END);
-  long size = std::ftell(f);
-  if (size < 0) { std::fclose(f); return -2; }
-  std::fseek(f, 0, SEEK_SET);
-  out->resize(static_cast<size_t>(size));
-  size_t got = size ? std::fread(&(*out)[0], 1, out->size(), f) : 0;
+  out->resize(cap);
+  size_t got = std::fread(&(*out)[0], 1, cap, f);
+  if (got < cap && std::ferror(f)) { std::fclose(f); return -3; }
   std::fclose(f);
-  return got == out->size() ? 0 : -3;
+  out->resize(got);
+  return 0;
+}
+
+// strtol with whole-token validation: "12abc" is a header error, not 12
+// (matches the Python tokenizer's int() strictness).
+bool parse_dim(const std::string& tok, long* out) {
+  if (tok.empty()) return false;
+  char* end = nullptr;
+  long v = std::strtol(tok.c_str(), &end, 10);
+  if (end == nullptr || *end != '\0') return false;
+  *out = v;
+  return true;
 }
 
 // Whitespace-delimited header token, '#' comments skipped.
@@ -66,8 +78,9 @@ extern "C" {
 // first payload byte). Returns 0, or <0 on malformed/mismatched input.
 int gol_pgm_read_header(const char* path, int64_t* w, int64_t* h,
                         int64_t* payload_off) {
+  // 64 KB bounds even comment-heavy headers; the payload is never needed.
   std::string buf;
-  if (int rc = read_all(path, &buf)) return rc;
+  if (int rc = read_prefix(path, 64 * 1024, &buf)) return rc;
   size_t pos = 0;
   std::string tok;
   if (!next_token(buf, &pos, &tok) || tok != "P5") return -10;
@@ -75,10 +88,9 @@ int gol_pgm_read_header(const char* path, int64_t* w, int64_t* h,
   if (!next_token(buf, &pos, &ws) || !next_token(buf, &pos, &hs) ||
       !next_token(buf, &pos, &ms))
     return -11;
-  char* end = nullptr;
-  long wv = std::strtol(ws.c_str(), &end, 10);
-  long hv = std::strtol(hs.c_str(), &end, 10);
-  long mv = std::strtol(ms.c_str(), &end, 10);
+  long wv, hv, mv;
+  if (!parse_dim(ws, &wv) || !parse_dim(hs, &hv) || !parse_dim(ms, &mv))
+    return -11;
   if (wv <= 0 || hv <= 0) return -12;
   if (mv != kMaxval) return -13;  // reference contract: maxval MUST be 255
   *w = wv;

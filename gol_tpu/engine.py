@@ -108,10 +108,18 @@ class Engine:
         self._devices = list(devices if devices is not None else jax.devices())
         self._rule = rule
         if mesh_shape is None:
-            spec = os.environ.get("GOL_MESH", "")
+            spec = os.environ.get("GOL_MESH", "").lower()
             if "x" in spec:
-                r, c = spec.lower().split("x", 1)
-                mesh_shape = (int(r), int(c))
+                try:
+                    r, c = spec.split("x", 1)
+                    mesh_shape = (int(r), int(c))
+                except ValueError:
+                    import warnings
+
+                    warnings.warn(
+                        f"GOL_MESH={spec!r} is not 'RxC'; "
+                        "falling back to 1-D row sharding")
+                    mesh_shape = None
         self._mesh_shape = mesh_shape
         self._state_lock = threading.Lock()
         # Row-sharded board: bit-packed uint32 (H, W/32) whenever the width
@@ -276,22 +284,32 @@ class Engine:
     # -------------------------------------------------------- checkpointing
 
     def save_checkpoint(self, path: str) -> None:
-        """Atomically write (world, turn) as a compressed .npz."""
+        """Atomically write (world, turn, rulestring) as a compressed .npz."""
         world, turn = self._snapshot()
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            np.savez_compressed(f, world=world, turn=turn)
+            np.savez_compressed(
+                f, world=world, turn=turn,
+                rulestring=self._rule.rulestring)
         os.replace(tmp, path)
 
     def load_checkpoint(self, path: str) -> int:
         """Restore (world, turn) from a checkpoint; returns the turn.
         The restored state serves `get_world`/`alive_count` immediately,
         so a controller can reattach with CONT=yes as if the engine had
-        never died."""
+        never died. A checkpoint recording a different rule than this
+        engine's is rejected — silently resuming evolution under the
+        wrong rule would corrupt the run."""
         self._check_alive()
         with np.load(path) as z:
             world = z["world"]
             turn = int(z["turn"])
+            if "rulestring" in z.files:
+                ckpt_rule = str(z["rulestring"])
+                if ckpt_rule != self._rule.rulestring:
+                    raise ValueError(
+                        f"checkpoint rule {ckpt_rule!r} != engine rule "
+                        f"{self._rule.rulestring!r}")
         height, width = world.shape
         packed, _ = select_representation(width)
         cells01 = from_pixels(world)

@@ -36,9 +36,10 @@ _u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
 def ensure_built(quiet: bool = True) -> bool:
     """Build (or freshen) build/libgolnative.so via csrc/Makefile — make's
     own dependency check makes this a no-op when the .so is newer than the
-    source, and an always-run keeps a stale library from shadowing source
-    edits. Returns True when the library is present afterwards. Note: a
-    library already loaded into this process is not reloaded."""
+    source. `lib()` only calls this when its stat check says the .so is
+    missing or stale; call it directly to force a freshness pass. Returns
+    True when the library is present afterwards. Note: a library already
+    loaded into this process is not reloaded."""
     try:
         subprocess.run(
             ["make", "-C", str(_REPO_ROOT / "csrc")],
@@ -75,6 +76,23 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
     return cdll
 
 
+def _so_is_stale() -> bool:
+    """True when the .so is missing or older than any csrc source — the
+    same dependency check make would do, as two stat calls instead of a
+    spawned process (so innocuous read paths like io.pgm.read_pgm never
+    fork a compiler inside a serving process)."""
+    try:
+        so_mtime = _LIB_PATH.stat().st_mtime
+    except OSError:
+        return True
+    try:
+        return any(
+            p.is_file() and p.stat().st_mtime > so_mtime
+            for p in (_REPO_ROOT / "csrc").glob("*"))
+    except OSError:
+        return False  # a source vanished mid-scan: keep the loaded .so
+
+
 def lib(build: bool = False) -> Optional[ctypes.CDLL]:
     """The loaded native library, or None when unavailable."""
     global _lib, _load_attempted
@@ -84,10 +102,10 @@ def lib(build: bool = False) -> Optional[ctypes.CDLL]:
         if _load_attempted and not build:
             return None
         _load_attempted = True
-        # Freshen unconditionally on the first load attempt: make's own
-        # dependency check is a cheap no-op when the .so is current, and
-        # this keeps a stale library from shadowing source edits.
-        ensure_built()
+        # Only spawn a build when the .so is missing or demonstrably
+        # stale (stat check); the common hot path is a plain dlopen.
+        if _so_is_stale():
+            ensure_built()
         if not _LIB_PATH.exists():
             return None
         try:

@@ -27,11 +27,12 @@ bit-identical; tests cross-check against the jnp path):
   transposes back — two transposes per K turns.
 
 Eligibility: the whole packed board (plus the ~16x working set of the adder
-network) must fit in VMEM — `fits_in_vmem` gates it — and the kernel is
-currently dispatched on the single-shard path only
-(`parallel/halo.py:_single_device_packed_run`). Larger boards and
-multi-shard meshes use the jnp packed path; composing this kernel
-per-shard under a deep-halo exchange is planned, not implemented.
+network) must fit in VMEM — `fits_in_vmem` gates it. Dispatch sites: the
+single-shard path (`parallel/halo.py:_single_device_packed_run`, which
+prefers the banded kernel when it applies), and per-shard under deep-halo
+macro-stepping on multi-shard meshes (`parallel/halo.py:inner_kind`
+composes this kernel on each shard's haloed window when it fits VMEM).
+Boards too big for either kernel use the jnp packed scan.
 
 Used on TPU; `interpret=True` runs the same kernel on CPU for tests.
 """

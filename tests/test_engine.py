@@ -170,3 +170,62 @@ def test_multihost_noop_without_coordinator(monkeypatch):
     monkeypatch.delenv("GOL_COORDINATOR", raising=False)
     assert multihost.initialize() is False
     assert multihost.is_multihost() is False
+
+
+def test_checkpoint_roundtrip_autosave_and_resume(tmp_path, monkeypatch):
+    """GOL_CKPT autosave + load_checkpoint must reproduce an uninterrupted
+    run: autosaved (world, turn, rule) restored into a fresh engine and
+    evolved for the remaining turns matches the straight-through board."""
+    from gol_tpu.engine import CKPT_ENV, CKPT_EVERY_ENV
+
+    w = board(32, 32, seed=7)
+    ckpt_dir = tmp_path / "ckpt"
+    monkeypatch.setenv(CKPT_ENV, str(ckpt_dir))
+    monkeypatch.setenv(CKPT_EVERY_ENV, "0")  # checkpoint every chunk
+    eng = Engine()
+    p = Params(threads=1, image_width=32, image_height=32, turns=30)
+    eng.server_distributor(p, w)
+    ckpt = ckpt_dir / "32x32.npz"
+    assert ckpt.exists(), "GOL_CKPT autosave never fired"
+
+    monkeypatch.delenv(CKPT_ENV)
+    fresh = Engine()
+    turn = fresh.load_checkpoint(str(ckpt))
+    assert 0 < turn <= 30
+    snap, t = fresh.get_world()
+    assert t == turn
+    # resume the remaining turns from the restored snapshot
+    if turn < 30:
+        p2 = Params(
+            threads=1, image_width=32, image_height=32, turns=30 - turn)
+        snap, t = fresh.server_distributor(p2, snap, start_turn=turn)
+    assert t == 30
+    want = run_turns_np((w != 0).astype(np.uint8), 30)
+    np.testing.assert_array_equal((snap != 0).astype(np.uint8), want)
+
+
+def test_checkpoint_rule_mismatch_rejected(tmp_path):
+    """A checkpoint written under one rule must not silently resume under
+    another (ADVICE r1): load into a HighLife engine raises."""
+    from gol_tpu.models.lifelike import LifeLikeRule
+
+    eng = Engine()
+    p = Params(threads=1, image_width=16, image_height=16, turns=3)
+    eng.server_distributor(p, board(16, 16))
+    path = str(tmp_path / "c.npz")
+    eng.save_checkpoint(path)
+
+    other = Engine(rule=LifeLikeRule("B36/S23"))
+    with pytest.raises(ValueError, match="checkpoint rule"):
+        other.load_checkpoint(path)
+
+
+def test_gol_mesh_malformed_falls_back(monkeypatch):
+    """A malformed GOL_MESH env var must warn and fall back to 1-D
+    sharding, not crash engine construction (ADVICE r1)."""
+    monkeypatch.setenv("GOL_MESH", "axb")
+    with pytest.warns(UserWarning, match="GOL_MESH"):
+        eng = Engine()
+    assert eng._mesh_shape is None
+    monkeypatch.setenv("GOL_MESH", "2x4")
+    assert Engine()._mesh_shape == (2, 4)

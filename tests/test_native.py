@@ -97,3 +97,29 @@ def test_render_halfblocks():
     assert lines[0][1] == "▄"
     assert lines[1][2] == "█"
     assert lines[0][2:] == "    "
+
+
+def test_native_header_rejects_partial_numeric_tokens(tmp_path):
+    """'12abc' must be a header error, not 12 — native parity with the
+    Python tokenizer's int() strictness (ADVICE r1)."""
+    p = tmp_path / "bad.pgm"
+    p.write_bytes(b"P5\n12abc 16\n255\n" + bytes(16 * 16))
+    with pytest.raises(ValueError, match="header"):
+        native.read_pgm(str(p))
+    # sanity: the same dims well-formed still parse
+    good = tmp_path / "good.pgm"
+    good.write_bytes(b"P5\n16 16\n255\n" + bytes(256))
+    assert native.read_pgm(str(good)).shape == (16, 16)
+
+
+def test_native_header_reads_prefix_only(tmp_path):
+    """Header parse must not slurp the payload: a giant sparse file's
+    header parses instantly (ADVICE r1 — single-pass design)."""
+    p = tmp_path / "big.pgm"
+    h = w = 4096
+    with open(p, "wb") as f:
+        f.write(b"P5\n%d %d\n255\n" % (w, h))
+        f.seek(len(b"P5\n%d %d\n255\n" % (w, h)) + h * w - 1)
+        f.write(b"\x00")
+    board = native.read_pgm(str(p))
+    assert board.shape == (h, w) and board.sum() == 0
