@@ -26,10 +26,22 @@ ROWS_AXIS = "rows"
 
 
 def resolve_shard_count(height: int, requested: int) -> int:
-    """Largest n ≤ requested with height % n == 0 (and n ≥ 1)."""
+    """Largest n ≤ requested with height % n == 0 (and n ≥ 1). A downgrade
+    (non-divisor request, e.g. 7 shards for a 512-row board) is served at
+    the reduced count and warned about — the reference instead spreads
+    remainder rows (`Server:106-116`), so a user coming from it would
+    otherwise silently lose parallelism."""
     n = max(1, min(requested, height))
     while height % n != 0:
         n -= 1
+    if n != requested:
+        import warnings
+
+        warnings.warn(
+            f"shard request {requested} downgraded to {n}: board height "
+            f"{height} is not divisible by {requested}",
+            stacklevel=2,
+        )
     return n
 
 

@@ -125,7 +125,20 @@ def main() -> None:
     ap.add_argument("--resume", metavar="CKPT", default="",
                     help="restore (world, turn) from a checkpoint .npz "
                          "before serving (pairs with GOL_CKPT autosaves)")
+    ap.add_argument("--coordinator", metavar="HOST:PORT", default="",
+                    help="multi-host engine: jax.distributed coordinator "
+                         "address (falls back to GOL_COORDINATOR; unset = "
+                         "single-host)")
     args = ap.parse_args()
+    # Join the multi-host engine cluster BEFORE the engine snapshots
+    # jax.devices() — after this, meshes span the pod (SURVEY §2d).
+    from gol_tpu.parallel import multihost
+
+    if multihost.initialize(args.coordinator or None):
+        import jax
+
+        print(f"multi-host engine: process {jax.process_index()}/"
+              f"{jax.process_count()}, {len(jax.devices())} device(s)")
     srv = EngineServer(port=args.port, host=args.host)
     if args.resume:
         turn = srv.engine.load_checkpoint(args.resume)

@@ -22,6 +22,12 @@ import numpy as np
 
 _LEN = struct.Struct(">I")
 MAX_HEADER = 1 << 20
+# Upper bound on h*w accepted from a peer before allocating: 2^33 cells
+# (8 GiB, comfortably above the 65536² flagship board at 2^32) — a
+# hostile or garbage header must not be able to trigger an arbitrary-size
+# allocation. The reference trusts gob inside a VPC; a hand-rolled TCP
+# plane bounds its inputs.
+MAX_BOARD_CELLS = 1 << 33
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -57,6 +63,8 @@ def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
     world = None
     if "world" in header and header["world"] is not None:
         h, w = int(header["world"]["h"]), int(header["world"]["w"])
+        if h <= 0 or w <= 0 or h * w > MAX_BOARD_CELLS:
+            raise ConnectionError(f"board dims out of bounds: {h}x{w}")
         world = np.frombuffer(
             _recv_exact(sock, h * w), dtype=np.uint8
         ).reshape(h, w).copy()

@@ -17,7 +17,11 @@ SIZES_TURNS = [
     (64, 0), (64, 1), (64, 100),
     (512, 0), (512, 1), (512, 100),
 ]
-SHARDS = [1, 4, 8]
+# Full shard-request sweep 1..8, the analog of the reference's threads
+# 1..16 sweep (`Local/gol_test.go:25`) at this mesh's device count.
+# Non-divisors (3, 5, 6, 7 against power-of-two heights) push the
+# resolve_shard_count divisor fallback through the whole gol.run stack.
+SHARDS = [1, 2, 3, 4, 5, 6, 7, 8]
 
 
 def run_and_get_final(p, images_dir, out_dir, sub_count, monkeypatch):
@@ -39,8 +43,6 @@ def run_and_get_final(p, images_dir, out_dir, sub_count, monkeypatch):
 @pytest.mark.parametrize("size,turns", SIZES_TURNS)
 def test_gol(size, turns, shards, images_dir, check_dir, out_dir,
              monkeypatch):
-    if size == 512 and shards != 8 and turns == 100:
-        pytest.skip("512x100 covered at 8 shards; keep suite fast")
     p = Params(threads=8, image_width=size, image_height=size, turns=turns)
     final = run_and_get_final(p, images_dir, out_dir, shards, monkeypatch)
     assert final.completed_turns == turns

@@ -12,7 +12,7 @@ from gol_tpu.utils.cell import read_alive_cells
 
 
 @pytest.mark.parametrize("size,turns", [(16, 100), (64, 100), (512, 1)])
-@pytest.mark.parametrize("shards", [1, 8])
+@pytest.mark.parametrize("shards", [1, 3, 5, 8])
 def test_pgm_output(size, turns, shards, images_dir, check_dir, out_dir,
                     monkeypatch, tmp_path):
     monkeypatch.delenv("SER", raising=False)
