@@ -19,6 +19,7 @@
 // C ABI only (consumed via ctypes from gol_tpu/native.py). All functions
 // return 0 on success or a negative errno-style code.
 
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -44,12 +45,14 @@ int read_prefix(const char* path, size_t cap, std::string* out) {
 }
 
 // strtol with whole-token validation: "12abc" is a header error, not 12
-// (matches the Python tokenizer's int() strictness).
+// (matches the Python tokenizer's int() strictness), and an out-of-range
+// token is an error rather than a silent clamp to LONG_MAX.
 bool parse_dim(const std::string& tok, long* out) {
   if (tok.empty()) return false;
   char* end = nullptr;
+  errno = 0;
   long v = std::strtol(tok.c_str(), &end, 10);
-  if (end == nullptr || *end != '\0') return false;
+  if (errno == ERANGE || end == nullptr || *end != '\0') return false;
   *out = v;
   return true;
 }

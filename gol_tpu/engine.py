@@ -113,6 +113,8 @@ class Engine:
                 try:
                     r, c = spec.split("x", 1)
                     mesh_shape = (int(r), int(c))
+                    if mesh_shape[0] <= 0 or mesh_shape[1] <= 0:
+                        raise ValueError("non-positive mesh dims")
                 except ValueError:
                     import warnings
 
@@ -335,6 +337,8 @@ class Engine:
 
         r, c = self._mesh_shape
         wp = width // WORD_BITS
+        if r <= 0 or c <= 0:
+            return None
         if r * c > len(self._devices) or height % r or wp % c:
             return None
         return make_mesh2d((r, c), self._devices)

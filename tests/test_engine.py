@@ -229,3 +229,13 @@ def test_gol_mesh_malformed_falls_back(monkeypatch):
     assert eng._mesh_shape is None
     monkeypatch.setenv("GOL_MESH", "2x4")
     assert Engine()._mesh_shape == (2, 4)
+
+
+def test_gol_mesh_nonpositive_dims_fall_back(monkeypatch):
+    """GOL_MESH='0x4' / '2x-4' must warn and fall back, not crash later
+    in mesh construction."""
+    for spec in ("0x4", "2x-4"):
+        monkeypatch.setenv("GOL_MESH", spec)
+        with pytest.warns(UserWarning, match="GOL_MESH"):
+            eng = Engine()
+        assert eng._mesh_shape is None

@@ -123,3 +123,12 @@ def test_native_header_reads_prefix_only(tmp_path):
         f.write(b"\x00")
     board = native.read_pgm(str(p))
     assert board.shape == (h, w) and board.sum() == 0
+
+
+def test_native_header_rejects_out_of_range_dims(tmp_path):
+    """A dimension token beyond long range must be a clean header error,
+    not a silent clamp to LONG_MAX followed by a giant allocation."""
+    p = tmp_path / "huge.pgm"
+    p.write_bytes(b"P5\n99999999999999999999 16\n255\n" + bytes(16))
+    with pytest.raises(ValueError, match="header"):
+        native.read_pgm(str(p))

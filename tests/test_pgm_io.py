@@ -69,3 +69,15 @@ def test_path_contracts():
     # (`Local/gol/distributor.go:76-77,201`).
     assert input_path(512, 512) == "images/512x512.pgm"
     assert output_path(512, 512, 100) == "out/512x512x100.pgm"
+
+
+def test_comment_heavy_header_parses_with_or_without_native(tmp_path):
+    """A spec-legal P5 with >64 KB of comments before the dims must parse
+    identically whether or not the native codec is built: the native
+    tokenizer caps header reads at 64 KB, and read_pgm falls back to the
+    Python parser when the native one rejects."""
+    p = tmp_path / "c.pgm"
+    comments = b"# pad\n" * 20000  # ~120 KB of comment lines
+    p.write_bytes(b"P5\n" + comments + b"16 16\n255\n" + bytes(256))
+    board = read_pgm(str(p))
+    assert board.shape == (16, 16) and board.sum() == 0

@@ -1,0 +1,178 @@
+"""SDL branch coverage via a ctypes-stub fake SDL2 (no real libSDL2 in
+the image): pins the init/render call sequence and the keysym-offset
+event decode of `gol_tpu/sdl/window.py` against the reference's window
+contract (`Local/sdl/window.go:20-82`). When a real libSDL2 is present,
+an extra smoke test runs it under SDL_VIDEODRIVER=dummy."""
+
+import ctypes
+import struct
+
+import numpy as np
+import pytest
+
+import gol_tpu.sdl.window as win_mod
+from gol_tpu.sdl.window import (
+    Window,
+    _SDL_KEYDOWN,
+    _SDL_PIXELFORMAT_ARGB8888,
+    _SDL_QUIT,
+    _SDL_TEXTUREACCESS_STREAMING,
+)
+
+
+class FakeFn:
+    """Callable attribute standing in for a ctypes foreign function:
+    records calls, returns a canned value, tolerates .restype/.argtypes
+    assignment exactly like a real ctypes function pointer."""
+
+    def __init__(self, log, name, ret=0, impl=None):
+        self._log, self._name, self._ret, self._impl = log, name, ret, impl
+
+    def __call__(self, *args):
+        self._log.append((self._name, args))
+        if self._impl is not None:
+            return self._impl(*args)
+        return self._ret
+
+
+class FakeSDL:
+    """Just enough of libSDL2's surface for Window, with an injectable
+    event queue for SDL_PollEvent."""
+
+    _RETURNS = {
+        "SDL_Init": 0,
+        "SDL_CreateWindow": 0xD00D,
+        "SDL_CreateRenderer": 0xBEE5,
+        "SDL_CreateTexture": 0xF00D,
+    }
+
+    def __init__(self):
+        self.log = []
+        self.pending_events = []
+        self._fns = {}
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._fns:
+            impl = self._poll_event if name == "SDL_PollEvent" else None
+            self._fns[name] = FakeFn(
+                self.log, name, self._RETURNS.get(name, 0), impl)
+        return self._fns[name]
+
+    def calls(self, *names):
+        return [c for c in self.log if c[0] in names]
+
+    def _poll_event(self, ev_ref):
+        if not self.pending_events:
+            return 0
+        etype, sym = self.pending_events.pop(0)
+        # write through the byref() into Window.poll_event's 64-byte
+        # event buffer: etype (u32) at offset 0, keysym.sym (i32) at
+        # offset 20 — the exact layout the decoder relies on
+        buf = ev_ref._obj
+        ctypes.memset(buf, 0, 64)
+        struct.pack_into("<I", buf, 0, etype)
+        struct.pack_into("<i", buf, 20, sym)
+        return 1
+
+
+@pytest.fixture
+def fake_sdl(monkeypatch):
+    fake = FakeSDL()
+    monkeypatch.setattr(win_mod, "_SDL", fake)
+    monkeypatch.delenv("GOL_HEADLESS", raising=False)
+    return fake
+
+
+def test_init_sequence_and_texture_params(fake_sdl):
+    w = Window(64, 32, scale=4)
+    names = [n for n, _ in fake_sdl.log]
+    assert names[:4] == [
+        "SDL_Init", "SDL_CreateWindow", "SDL_CreateRenderer",
+        "SDL_CreateTexture",
+    ]
+    _, cw_args = fake_sdl.calls("SDL_CreateWindow")[0]
+    assert cw_args[0] == b"gol_tpu"
+    assert cw_args[3:5] == (64 * 4, 32 * 4)  # scaled window, unscaled board
+    _, tex_args = fake_sdl.calls("SDL_CreateTexture")[0]
+    assert tex_args[1] == _SDL_PIXELFORMAT_ARGB8888
+    assert tex_args[2] == _SDL_TEXTUREACCESS_STREAMING
+    assert tex_args[3:5] == (64, 32)
+    assert w._sdl is fake_sdl
+
+
+def test_render_frame_order_and_pixels(fake_sdl):
+    w = Window(8, 4)
+    w.set_pixel(2, 1, True)
+    fake_sdl.log.clear()
+    w.render_frame()
+    assert [n for n, _ in fake_sdl.log] == [
+        "SDL_UpdateTexture", "SDL_RenderClear", "SDL_RenderCopy",
+        "SDL_RenderPresent",
+    ]
+    _, up_args = fake_sdl.calls("SDL_UpdateTexture")[0]
+    assert up_args[3] == 8 * 4  # pitch = width * sizeof(ARGB)
+    argb = np.frombuffer(up_args[2], dtype=np.uint32).reshape(4, 8)
+    assert argb[1, 2] == 0xFFFFFFFF  # alive -> white
+    assert argb[0, 0] == 0xFF000000  # dead -> opaque black
+
+
+def test_poll_event_keysym_offset_decode(fake_sdl):
+    w = Window(16, 16)
+    fake_sdl.pending_events = [(_SDL_KEYDOWN, ord("p"))]
+    assert w.poll_event() == "p"
+    for key in "sqk":
+        fake_sdl.pending_events = [(_SDL_KEYDOWN, ord(key))]
+        assert w.poll_event() == key
+    # non-control keys are swallowed, not returned
+    fake_sdl.pending_events = [(_SDL_KEYDOWN, ord("x"))]
+    assert w.poll_event() is None
+    # window close
+    fake_sdl.pending_events = [(_SDL_QUIT, 0)]
+    assert w.poll_event() == "quit"
+    # empty queue
+    assert w.poll_event() is None
+
+
+def test_close_sequence(fake_sdl):
+    w = Window(16, 16)
+    fake_sdl.log.clear()
+    w.close()
+    assert [n for n, _ in fake_sdl.log] == ["SDL_DestroyWindow", "SDL_Quit"]
+    assert w._sdl is None
+    w.close()  # idempotent
+    assert [n for n, _ in fake_sdl.log] == ["SDL_DestroyWindow", "SDL_Quit"]
+
+
+def test_headless_env_suppresses_sdl(fake_sdl, monkeypatch):
+    monkeypatch.setenv("GOL_HEADLESS", "1")
+    w = Window(16, 16)
+    assert w._sdl is None and fake_sdl.log == []
+    assert w.poll_event() is None  # no SDL -> no events, no crash
+
+
+def test_init_failure_falls_back(fake_sdl):
+    fake_sdl._RETURNS = dict(fake_sdl._RETURNS, SDL_Init=-1)
+    w = Window(16, 16)
+    assert w._sdl is None  # failed init -> terminal fallback, not a crash
+
+
+def test_create_window_failure_falls_back(fake_sdl):
+    fake_sdl._RETURNS = dict(fake_sdl._RETURNS, SDL_CreateWindow=0)
+    w = Window(16, 16)
+    assert w._sdl is None
+
+
+@pytest.mark.skipif(
+    not win_mod.sdl_available(), reason="no real libSDL2 in this image")
+def test_real_sdl_dummy_driver_smoke(monkeypatch):
+    monkeypatch.setenv("SDL_VIDEODRIVER", "dummy")
+    monkeypatch.delenv("GOL_HEADLESS", raising=False)
+    w = Window(32, 32)
+    try:
+        w.flip_pixel(3, 3)
+        w.render_frame()
+        w.poll_event()
+    finally:
+        w.close()
