@@ -16,6 +16,19 @@ Public surface (mirrors reference `Local/gol/gol.go:4-12`):
         events, key_presses)
 """
 
+import os as _os
+
+if _os.environ.get("GOL_COMPILE_CACHE"):
+    # Opt-in persistent XLA compilation cache: kills the engine's cold
+    # chunk-ramp compile cost (~17 power-of-two loop lengths) across
+    # process restarts. Must be configured before the first compile.
+    import jax as _jax
+
+    _jax.config.update(
+        "jax_compilation_cache_dir", _os.environ["GOL_COMPILE_CACHE"])
+    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
 from gol_tpu.params import Params
 from gol_tpu.events import (
     AliveCellsCount,

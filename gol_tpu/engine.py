@@ -58,7 +58,7 @@ FLAG_QUIT = 2
 FLAG_KILL = 5
 
 CHUNK_TARGET_SECONDS = 0.15
-MAX_CHUNK = 1024
+MAX_CHUNK = 1 << 20
 
 # GOL_TRACE=<dir>: dump one jax.profiler trace of a representative chunk
 # per run — the counterpart of the reference's runtime/trace TestTrace
@@ -133,6 +133,9 @@ class Engine:
         self._flags: "queue.Queue[int]" = queue.Queue()
         self._killed = False
         self._running = False
+        # Dispatch-floor estimate for the chunk adapter (min elapsed ever
+        # observed for a full chunk); engine-lifetime, it only sharpens.
+        self._fixed_cost_est = float("inf")
 
     # ------------------------------------------------------------------ RPC
 
@@ -357,12 +360,26 @@ class Engine:
         return np.asarray(jax.device_get(to_pixels(cells))), turn
 
     def _adapt_chunk(self, chunk: int, k: int, elapsed: float) -> int:
-        """Double/halve the power-of-two chunk toward CHUNK_TARGET_SECONDS."""
+        """Double/halve the power-of-two chunk so the MARGINAL compute per
+        chunk approaches CHUNK_TARGET_SECONDS.
+
+        Every dispatch carries a fixed host↔device cost (measured ~0.2 s
+        per program round-trip through the axon tunnel — independent of
+        chunk size), so adapting on raw `elapsed` deadlocks: at chunk=1
+        elapsed is already above any sub-second target and the run stays
+        pinned at one turn per round-trip (~5 turns/s on a kernel capable
+        of millions). Instead the adapter tracks the smallest elapsed ever
+        seen (`_fixed_cost_est`, the dispatch floor — no chunk can beat
+        it) and grows while compute-above-floor is under target. Pause /
+        quit / snapshot latency is bounded by floor + 2x target, and the
+        floor is irreducible anyway: even a 1-turn chunk pays it."""
         if k != chunk:
             return chunk  # partial (remainder) chunk — timing unrepresentative
-        if elapsed < CHUNK_TARGET_SECONDS / 2 and chunk < MAX_CHUNK:
+        self._fixed_cost_est = min(self._fixed_cost_est, elapsed)
+        marginal = elapsed - self._fixed_cost_est
+        if marginal < CHUNK_TARGET_SECONDS and chunk < MAX_CHUNK:
             return chunk * 2
-        if elapsed > CHUNK_TARGET_SECONDS * 2 and chunk > 1:
+        if marginal > CHUNK_TARGET_SECONDS * 2 and chunk > 1:
             return chunk // 2
         return chunk
 
