@@ -256,18 +256,29 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=None,
                     help="single dense config (default: full matrix)")
-    ap.add_argument("--turns", type=int, default=None)
+    ap.add_argument("--turns", type=int, default=None,
+                    help="timed turn count; single-config runs only — "
+                         "matrix legs each need a latency-amortising "
+                         "count of their own (see module docstring)")
     ap.add_argument("--warmup-turns", type=int, default=128)
     ap.add_argument("--pattern", choices=["dense", "rpentomino"],
                     default="dense")
     args = ap.parse_args()
 
     if args.pattern == "rpentomino":
-        return bench_rpentomino(args.turns or SPARSE_TURNS)
+        turns = args.turns if args.turns is not None else SPARSE_TURNS
+        return bench_rpentomino(turns)
 
     if args.size is not None:
-        turns = args.turns or default_turns(args.size)
+        turns = (args.turns if args.turns is not None
+                 else default_turns(args.size))
         return bench_dense(args.size, turns, args.warmup_turns)
+
+    if args.turns is not None:
+        ap.error("--turns requires --size or --pattern rpentomino; a "
+                 "single count applied to every matrix leg would re-create "
+                 "the fixed-latency-dominated measurement the module "
+                 "docstring warns about")
 
     # Full BASELINE matrix, the 512² north-star line LAST (the driver
     # parses the tail of stdout). Each leg is isolated: a crash in one
@@ -285,11 +296,9 @@ def main() -> int:
         return nonlocal_rc
 
     for n in (5120, 65536):
-        rc |= leg(bench_dense, n, args.turns or default_turns(n),
-                  args.warmup_turns)
-    rc |= leg(bench_rpentomino, args.turns or SPARSE_TURNS)
-    rc |= leg(bench_dense, 512, args.turns or default_turns(512),
-              args.warmup_turns)
+        rc |= leg(bench_dense, n, default_turns(n), args.warmup_turns)
+    rc |= leg(bench_rpentomino, SPARSE_TURNS)
+    rc |= leg(bench_dense, 512, default_turns(512), args.warmup_turns)
     return rc
 
 

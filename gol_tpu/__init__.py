@@ -22,12 +22,25 @@ if _os.environ.get("GOL_COMPILE_CACHE"):
     # Opt-in persistent XLA compilation cache: kills the engine's cold
     # chunk-ramp compile cost (~17 power-of-two loop lengths) across
     # process restarts. Must be configured before the first compile.
+    # Each option is guarded: on a JAX version lacking one of these
+    # config names, degrade to whatever subset exists (worst case no
+    # persistent cache) rather than making `import gol_tpu` itself raise.
+    import warnings as _warnings
+
     import jax as _jax
 
-    _jax.config.update(
-        "jax_compilation_cache_dir", _os.environ["GOL_COMPILE_CACHE"])
-    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    for _name, _value in (
+        ("jax_compilation_cache_dir", _os.environ["GOL_COMPILE_CACHE"]),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+    ):
+        try:
+            _jax.config.update(_name, _value)
+        except (AttributeError, KeyError, ValueError) as _e:
+            _warnings.warn(
+                f"GOL_COMPILE_CACHE: jax.config has no {_name!r} "
+                f"({_e}); persistent compile cache may be degraded")
+    del _warnings, _name, _value
 
 from gol_tpu.params import Params
 from gol_tpu.events import (

@@ -62,11 +62,14 @@ def read_pgm(path: str) -> np.ndarray:
 
     try:
         board = native.read_pgm(path)  # single-pass C++ codec when built
-    except ValueError:
-        # The native parser is allowed to be stricter than the format
-        # (e.g. its header tokenizer caps comment blocks at 64 KB);
+    except native.HeaderParseError:
+        # The native header tokenizer is allowed to be stricter than the
+        # format (e.g. it caps comment blocks at a 64 KB prefix);
         # re-parse in Python so acceptance semantics are identical with
-        # and without the .so — a truly bad file raises again below.
+        # and without the .so — a truly bad header raises again below.
+        # Payload-level failures (bad cell bytes, short payload) raise
+        # plain ValueError above and propagate: re-reading a large file
+        # just to fail identically would waste the single-pass design.
         board = None
     if board is not None:
         return board

@@ -77,17 +77,22 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def _so_is_stale() -> bool:
-    """True when the .so is missing or older than any csrc source — the
-    same dependency check make would do, as two stat calls instead of a
-    spawned process (so innocuous read paths like io.pgm.read_pgm never
-    fork a compiler inside a serving process)."""
+    """True when the .so is missing or not strictly newer than any csrc
+    source — the dependency check make would do, as two stat calls instead
+    of a spawned process (so innocuous read paths like io.pgm.read_pgm
+    never fork a compiler inside a serving process). Equal mtimes count as
+    stale: git checkouts and tar extractions can stamp source and .so in
+    the same second, and only a real `make` run can tell them apart.
+    GOL_NATIVE_FRESHEN=1 forces the make pass unconditionally."""
+    if os.environ.get("GOL_NATIVE_FRESHEN"):
+        return True
     try:
         so_mtime = _LIB_PATH.stat().st_mtime
     except OSError:
         return True
     try:
         return any(
-            p.is_file() and p.stat().st_mtime > so_mtime
+            p.is_file() and p.stat().st_mtime >= so_mtime
             for p in (_REPO_ROOT / "csrc").glob("*"))
     except OSError:
         return False  # a source vanished mid-scan: keep the loaded .so
@@ -121,9 +126,18 @@ def available() -> bool:
 
 # ------------------------------------------------------------- wrappers
 
+class HeaderParseError(ValueError):
+    """Native header tokenizer rejected the file. The native parser is
+    allowed to be stricter than the format (e.g. it caps comment blocks
+    at a 64 KB prefix), so callers may re-parse the header in Python;
+    payload-level failures raise plain ValueError and are final."""
+
+
 def read_pgm(path: str) -> Optional[np.ndarray]:
     """Native PGM read; None if the library is unavailable. Raises
-    ValueError on malformed input (same contract as io.pgm.read_pgm)."""
+    HeaderParseError when the header is rejected (caller may fall back
+    to the Python parser) and plain ValueError on bad payload bytes
+    (same contract as io.pgm.read_pgm — not worth re-reading)."""
     l = lib()
     if l is None:
         return None
@@ -133,7 +147,7 @@ def read_pgm(path: str) -> Optional[np.ndarray]:
     if rc == -1:
         raise FileNotFoundError(path)
     if rc != 0:
-        raise ValueError(f"{path}: bad PGM header (native rc {rc})")
+        raise HeaderParseError(f"{path}: bad PGM header (native rc {rc})")
     board = np.empty((h.value, w.value), dtype=np.uint8)
     rc = l.gol_pgm_read_payload(
         path.encode(), off.value, board, w.value * h.value)

@@ -14,6 +14,7 @@ followed by exactly H*W raw payload bytes when "world" is present.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 from typing import Optional, Tuple
@@ -22,12 +23,12 @@ import numpy as np
 
 _LEN = struct.Struct(">I")
 MAX_HEADER = 1 << 20
-# Upper bound on h*w accepted from a peer before allocating: 2^33 cells
-# (8 GiB, comfortably above the 65536² flagship board at 2^32) — a
-# hostile or garbage header must not be able to trigger an arbitrary-size
-# allocation. The reference trusts gob inside a VPC; a hand-rolled TCP
-# plane bounds its inputs.
-MAX_BOARD_CELLS = 1 << 33
+# Upper bound on h*w accepted from a peer before allocating: 2^32 cells
+# (4 GiB, exactly the 65536² flagship board) — a hostile or garbage
+# header must not be able to trigger an arbitrary-size allocation. The
+# reference trusts gob inside a VPC; a hand-rolled TCP plane bounds its
+# inputs. Hosts serving larger boards raise it via GOL_MAX_BOARD_CELLS.
+MAX_BOARD_CELLS = int(os.environ.get("GOL_MAX_BOARD_CELLS", str(1 << 32)))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
