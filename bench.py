@@ -47,7 +47,7 @@ BASELINE_CUPS = 2.6e7  # see module docstring
 # dispatch latency (512² at 0.2 µs/turn, 5120² at ~0.42 ms/turn, 65536²
 # at ~5.9 ms/turn measured r1/r2).
 DEFAULT_TURNS = {512: 2_000_000, 5120: 8_000, 65536: 384}
-SPARSE_TURNS = 2_000
+SPARSE_TURNS = 8_192
 
 
 def default_turns(n: int) -> int:

@@ -21,6 +21,7 @@ window becomes the whole torus and this degenerates to the dense engine
 
 from __future__ import annotations
 
+import functools
 from typing import Iterable, List, Optional, Tuple
 
 import jax
@@ -41,21 +42,56 @@ R_PENTOMINO = ((1, 0), (2, 0), (0, 1), (1, 1), (1, 2))
 
 # Coarse alignment ladder: every distinct window shape costs one XLA/pallas
 # compile, so shapes are quantized aggressively and growth overshoots
-# (3x the needed margin) to keep regrowth — and thus recompiles — rare.
+# (1.5x the needed margin) to keep regrowth — and thus recompiles — rare.
 _ROW_ALIGN = 256         # window heights: multiples of 256 rows
 _COL_ALIGN = 2048        # window widths: multiples of 2048 cells
 _WIDE_COL_ALIGN = 4096   # beyond VMEM: 128-lane word alignment for banded
-_GROW_FACTOR = 3
+_GROW_NUM, _GROW_DEN = 3, 2   # headroom = need * 3/2 + 64
+
+# Macro-step sizing. Each macro-step is ONE device dispatch (the turn loop
+# and the occupancy reduction are fused into a single XLA program), so on a
+# remote/tunneled TPU the per-dispatch round trip (~100 ms measured) is the
+# dominant cost and macros should be as deep as the window margin allows.
+# Macro depths are quantized to powers of two in [_MACRO_MIN, cap] so the
+# set of (window shape, depth) compilations stays small and warmable.
+_MACRO_CAP = 2048   # sweep on the real chip: 2048 beats 1024/4096
+_MACRO_MIN = 256
+
+
+def _ladder_floor(v: int) -> int:
+    """Largest power-of-two macro depth ≥ _MACRO_MIN that is ≤ v;
+    0 if v < _MACRO_MIN."""
+    if v < _MACRO_MIN:
+        return 0
+    k = _MACRO_MIN
+    while k * 2 <= v:
+        k *= 2
+    return k
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_run(shape, num_turns: int, rule: LifeLikeRule, kind: str):
+    """jitted (packed) -> (packed', row_occupancy, col_word_occupancy):
+    `num_turns` torus turns with the `kind` single-device engine, plus the
+    popcount occupancy reductions of the RESULT — all one XLA program, so
+    an adaptive macro-step costs exactly one host round trip."""
+    from gol_tpu.parallel.halo import packed_run_by_kind
+
+    step = packed_run_by_kind(kind)
+
+    @jax.jit
+    def run(packed: jax.Array):
+        out = step(packed, num_turns, rule)
+        rows, cols = _occupancy(out)
+        return out, rows, cols
+    return run
 
 
 @jax.jit
-def _row_occupancy(packed: jax.Array) -> jax.Array:
-    return jnp.sum(lax.population_count(packed), axis=1, dtype=jnp.int32)
-
-
-@jax.jit
-def _col_word_occupancy(packed: jax.Array) -> jax.Array:
-    return jnp.sum(lax.population_count(packed), axis=0, dtype=jnp.int32)
+def _occupancy(packed: jax.Array):
+    rows = jnp.sum(lax.population_count(packed), axis=1, dtype=jnp.int32)
+    cols = jnp.sum(lax.population_count(packed), axis=0, dtype=jnp.int32)
+    return rows, cols
 
 
 def _round_up(v: int, align: int) -> int:
@@ -104,10 +140,19 @@ class SparseTorus:
         for x, y in zip(xs, ys):
             board[(y - self._oy) % size, (x - self._ox) % size] = 1
         self._packed = jax.device_put(pack(board))
+        # (row, col-word) popcount occupancy of `_packed`, as device
+        # arrays — refreshed for free by every fused macro-step.
+        self._occ = None
+        # Margins known analytically right after a `_grow` (no device
+        # round trip); invalidated by every step.
+        self._grown_margins = None
 
     # ------------------------------------------------------------- queries
 
     def alive_count(self) -> int:
+        if self._occ is not None:
+            rows = np.asarray(jax.device_get(self._occ[0]), dtype=np.int64)
+            return int(rows.sum())
         return packed_alive_count(self._packed)
 
     def window_shape(self) -> Tuple[int, int]:
@@ -129,8 +174,9 @@ class SparseTorus:
     def _margins(self) -> Optional[Tuple[int, int, int, int]]:
         """(top, bottom, left, right) dead margins of the window, with
         column granularity of one 32-bit word; None when no cell lives."""
-        rows = np.asarray(jax.device_get(_row_occupancy(self._packed)))
-        cols = np.asarray(jax.device_get(_col_word_occupancy(self._packed)))
+        if self._occ is None:
+            self._occ = _occupancy(self._packed)
+        rows, cols = (np.asarray(a) for a in jax.device_get(self._occ))
         live_rows = np.nonzero(rows)[0]
         live_cols = np.nonzero(cols)[0]
         if live_rows.size == 0:
@@ -152,7 +198,7 @@ class SparseTorus:
         w = wp * WORD_BITS
         live_h = h - top - bottom
         live_w = w - left - right
-        headroom = _GROW_FACTOR * need + 64
+        headroom = need * _GROW_NUM // _GROW_DEN + 64
         # Once the window outgrows one wide-align unit, snap widths to
         # 4096 cells (wp % 128 == 0) so the banded pallas kernel stays
         # eligible as the window leaves the VMEM budget.
@@ -177,28 +223,66 @@ class SparseTorus:
             % self.size
         self._oy = (self._oy + top - pad_top) % self.size
         self._packed = new
+        # The live extent is unchanged, so the new margins are exactly the
+        # paddings — no device round trip needed to re-measure.
+        pad_left = pad_left_words * WORD_BITS
+        self._grown_margins = (
+            pad_top, new_h - live_h - pad_top,
+            pad_left, new_w - live_w - pad_left,
+        )
+        self._occ = None
 
     # ------------------------------------------------------------- stepping
 
-    def run(self, turns: int, macro: int = 256) -> None:
-        """Advance `turns` turns in macro-steps of ≤ `macro`."""
-        from gol_tpu.parallel.halo import _single_device_packed_run
+    def _pick_macro(self, remaining: int, cap: int) -> int:
+        """Macro depth for the next fused dispatch, growing the window
+        first when its margin cannot cover a worthwhile depth.
 
+        Safety invariant (module docstring): a k-turn macro needs a dead
+        margin ≥ k + 1 on every side beforehand. Within that, prefer the
+        deepest quantized depth the CURRENT margin allows (each grow costs
+        a dispatch and larger windows cost compute, so spare margin is
+        spent before the window is regrown)."""
+        target = min(remaining, cap)
+        m = self._grown_margins
+        if m is None:
+            m = self._margins()
+        if m is None:
+            return -1  # pattern died out
+        mm = min(m)
+        if target <= mm - 1:
+            return target
+        k = _ladder_floor(mm - 1)  # < target here, since target > mm - 1
+        if k >= min(target, _MACRO_MIN):
+            return k
+        k = target if target < _MACRO_MIN else _ladder_floor(target)
+        self._grow(k + 1)
+        return k
+
+    def run(self, turns: int, macro: Optional[int] = None) -> None:
+        """Advance `turns` turns in adaptively-sized macro-steps of
+        ≤ `macro` (default `_MACRO_CAP`) turns each."""
+        from gol_tpu.parallel.halo import packed_run_kind
+
+        cap = macro if macro else _MACRO_CAP
         done = 0
         while done < turns:
-            k = min(macro, turns - done)
             h, wp = self._packed.shape
             full_torus = h >= self.size and wp * WORD_BITS >= self.size
-            if not full_torus:
-                margins = self._margins()
-                if margins is None:
+            if full_torus:
+                k = min(cap, turns - done)
+            else:
+                k = self._pick_macro(turns - done, cap)
+                if k < 0:
                     # Pattern died out: with no B0 birth (guarded in
                     # __init__) an empty board stays empty forever.
                     self.turn += turns - done
                     return
-                if min(margins) < k + 1:
-                    self._grow(k + 1)
-            self._packed = _single_device_packed_run(
-                self._packed, k, self.rule)
+            platform = next(iter(self._packed.devices())).platform
+            kind = packed_run_kind(self._packed.shape, platform)
+            run = _fused_run(self._packed.shape, k, self.rule, kind)
+            self._packed, rows, cols = run(self._packed)
+            self._occ = (rows, cols)
+            self._grown_margins = None
             done += k
             self.turn += k

@@ -233,31 +233,49 @@ def inner_kind(mesh: Mesh, window_shape) -> str:
     return "jnp"
 
 
-def _single_device_packed_run(
-    packed: jax.Array, num_turns: int, rule: LifeLikeRule
-) -> jax.Array:
-    """1-shard fast path: the multi-turn VMEM-resident pallas kernel on TPU
-    when the board fits, the banded halo-deep kernel when it doesn't, else
-    the jnp packed scan — no shard_map wrapper."""
+def packed_run_kind(shape, platform: str) -> str:
+    """Which single-device packed engine a (H, Wp) board gets on
+    `platform`: 'banded' | 'vmem' | 'jnp'. Banded first even when the whole
+    board would fit in VMEM: its small per-band working windows sustain ~5x
+    the op throughput of one big fori_loop carry (measured 282e9 vs 176e9
+    cups on 4096²). Static in (shape, platform) so callers can compose the
+    chosen engine inside their own jitted programs (`models/sparse.py`
+    fuses it with the occupancy reduction into one dispatch)."""
+    from gol_tpu.ops.pallas_stencil import banded_supported, fits_in_vmem
+
+    if platform == "tpu":
+        if banded_supported(shape):
+            return "banded"
+        if fits_in_vmem(shape):
+            return "vmem"
+    return "jnp"
+
+
+def packed_run_by_kind(kind: str):
+    """The `(packed, num_turns, rule) -> packed` engine for a
+    `packed_run_kind` result."""
     from gol_tpu.ops.bitpack import packed_run_turns
     from gol_tpu.ops.pallas_stencil import (
         banded_packed_run_turns,
-        banded_supported,
-        fits_in_vmem,
         pallas_packed_run_turns,
     )
 
+    return {
+        "banded": banded_packed_run_turns,
+        "vmem": pallas_packed_run_turns,
+        "jnp": packed_run_turns,
+    }[kind]
+
+
+def _single_device_packed_run(
+    packed: jax.Array, num_turns: int, rule: LifeLikeRule
+) -> jax.Array:
+    """1-shard fast path — no shard_map wrapper; engine choice per
+    `packed_run_kind`."""
     devices = getattr(packed, "devices", None)
     dev = next(iter(devices())) if devices else jax.devices()[0]
-    if dev.platform == "tpu":
-        # Banded first even when the whole board would fit in VMEM: its
-        # small per-band working windows sustain ~5x the op throughput of
-        # one big fori_loop carry (measured 282e9 vs 176e9 cups on 4096²).
-        if banded_supported(packed.shape):
-            return banded_packed_run_turns(packed, num_turns, rule)
-        if fits_in_vmem(packed.shape):
-            return pallas_packed_run_turns(packed, num_turns, rule)
-    return packed_run_turns(packed, num_turns, rule)
+    kind = packed_run_kind(packed.shape, dev.platform)
+    return packed_run_by_kind(kind)(packed, num_turns, rule)
 
 
 def sharded_packed_run_turns(

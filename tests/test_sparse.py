@@ -65,6 +65,30 @@ def test_pattern_near_torus_origin_wraps_coordinates():
     assert set(sp.alive_cells()) == {(0, 0), (1, 0), (2, 0)}
 
 
+def test_adaptive_macro_matches_dense_oracle():
+    # Default (adaptive) macro sizing: the first pick exceeds the initial
+    # margin, forcing a grow + quantized deep macro, then an exact tail —
+    # the result must still match the dense oracle cell-for-cell.
+    size_dense = 1024
+    start = [(x + 512, y + 512) for x, y in R_PENTOMINO]
+    turns = 300
+    want = cells_of(dense_evolve(size_dense, start, turns))
+
+    sp = SparseTorus(2**20, start)
+    sp.run(turns)  # no macro cap: adaptive ladder
+    assert set(sp.alive_cells()) == want
+    assert sp.turn == turns
+
+
+def test_cached_alive_count_matches_recount():
+    from gol_tpu.ops.bitpack import packed_alive_count
+
+    sp = SparseTorus(2**20, [(x + 100, y + 100) for x, y in R_PENTOMINO])
+    sp.run(120)
+    assert sp._occ is not None
+    assert sp.alive_count() == packed_alive_count(sp._packed)
+
+
 def test_rejects_bad_input():
     with pytest.raises(ValueError):
         SparseTorus(1000, [(0, 0)])  # size not a multiple of 32
