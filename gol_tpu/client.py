@@ -1,29 +1,66 @@
 """Remote engine client — the controller side of the control plane.
 
-Duck-typed to `Engine` (same 5 methods), so the distributor is agnostic to
-in-process vs remote engines. Counterpart of the reference controller's
-`rpc.DialHTTP` + `client.Call` usage (`Local/gol/distributor.go:94,182`):
-one TCP connection per call; `server_distributor` blocks on its connection
-for the whole run exactly like the Go blocking `API.ServerDistributor` call.
+Duck-typed to `Engine` (same method surface), so the distributor is
+agnostic to in-process vs remote engines. Counterpart of the reference
+controller's `rpc.DialHTTP` + `client.Call` usage
+(`Local/gol/distributor.go:94,182`): one TCP connection per call;
+`server_distributor` blocks on its connection for the whole run exactly
+like the Go blocking `API.ServerDistributor` call.
+
+Failure detection (beyond reference — its only story is `log.Fatal` on
+dial errors): while the blocking run call is outstanding, a heartbeat
+watchdog pings the engine every GOL_HB_INTERVAL seconds over separate
+connections; after GOL_HB_MISSES consecutive failures it closes the run
+socket, converting a silent hang (network partition, wedged host) into a
+prompt ConnectionError the distributor's reconnect logic can act on. A
+server that answers pings with EngineKilled is deliberately down, not
+lost — the watchdog stands down.
 """
 
 from __future__ import annotations
 
 import socket
+import threading
+import uuid
 from typing import Sequence, Tuple
 
 import numpy as np
 
 from gol_tpu.engine import EngineKilled
 from gol_tpu.params import Params
+from gol_tpu.utils.envcfg import env_float, env_int
 from gol_tpu.wire import recv_msg, send_msg
+
+HB_INTERVAL_ENV = "GOL_HB_INTERVAL"   # seconds between pings; 0 disables
+HB_MISSES_ENV = "GOL_HB_MISSES"       # consecutive failures before loss
+HB_INTERVAL_DEFAULT = 2.0
+HB_MISSES_DEFAULT = 3
+
+
+def _check_resp(resp: dict):
+    if not resp.get("ok"):
+        err = resp.get("error", "unknown engine error")
+        if err.startswith("killed:"):
+            raise EngineKilled(err)
+        raise RuntimeError(f"engine error: {err}")
+    return resp
 
 
 class RemoteEngine:
+    # Marks this engine as safe for the distributor's lost-engine recovery:
+    # ConnectionError/OSError from its calls mean the NETWORK/peer, not
+    # local engine internals (an in-process Engine's OSError — e.g. a full
+    # disk during checkpointing — must propagate, not trigger reconnects).
+    recoverable = True
+
     def __init__(self, address: str, timeout: float = 10.0) -> None:
         host, _, port = address.rpartition(":")
         self._addr = (host or "localhost", int(port))
         self._timeout = timeout
+        # Run-ownership token: lets abort_run() stop THIS controller's
+        # orphaned run after a transient partition without being able to
+        # touch a different controller's run.
+        self._token = uuid.uuid4().hex
 
     def _call(self, header: dict, world=None, timeout=None):
         sock = socket.create_connection(self._addr, timeout=self._timeout)
@@ -33,11 +70,7 @@ class RemoteEngine:
             resp, resp_world = recv_msg(sock)
         finally:
             sock.close()
-        if not resp.get("ok"):
-            err = resp.get("error", "unknown engine error")
-            if err.startswith("killed:"):
-                raise EngineKilled(err)
-            raise RuntimeError(f"engine error: {err}")
+        _check_resp(resp)
         return resp, resp_world
 
     # --- Engine interface -------------------------------------------------
@@ -49,22 +82,88 @@ class RemoteEngine:
         sub_workers: Sequence[str] = (),
         start_turn: int = 0,
     ) -> Tuple[np.ndarray, int]:
-        resp, out = self._call(
-            {
-                "method": "ServerDistributor",
-                "params": {
-                    "threads": params.threads,
-                    "image_width": params.image_width,
-                    "image_height": params.image_height,
-                    "turns": params.turns,
-                },
-                "sub_workers": list(sub_workers),
-                "start_turn": start_turn,
+        header = {
+            "method": "ServerDistributor",
+            "params": {
+                "threads": params.threads,
+                "image_width": params.image_width,
+                "image_height": params.image_height,
+                "turns": params.turns,
             },
-            world,
-            timeout=None,
-        )
+            "sub_workers": list(sub_workers),
+            "start_turn": start_turn,
+            "token": self._token,
+        }
+        hb_interval = env_float(HB_INTERVAL_ENV, HB_INTERVAL_DEFAULT)
+        hb_misses = env_int(HB_MISSES_ENV, HB_MISSES_DEFAULT)
+
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        # The run socket is idle for the whole (possibly multi-hour) run;
+        # without keepalive a NAT/firewall can evict the flow while fresh
+        # ping connections keep succeeding — a hang the watchdog can't see.
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        for opt, val in (("TCP_KEEPIDLE", 60), ("TCP_KEEPINTVL", 15),
+                         ("TCP_KEEPCNT", 4)):
+            if hasattr(socket, opt):
+                sock.setsockopt(
+                    socket.IPPROTO_TCP, getattr(socket, opt), val)
+        stop = threading.Event()
+        lost = threading.Event()
+
+        def watchdog() -> None:
+            misses = 0
+            while not stop.wait(hb_interval):
+                try:
+                    self.ping()
+                    misses = 0
+                except (EngineKilled, RuntimeError):
+                    return  # engine reachable (killed/errored ≠ lost)
+                except (ConnectionError, OSError):
+                    misses += 1
+                    if misses >= hb_misses:
+                        lost.set()
+                        try:
+                            sock.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        sock.close()
+                        return
+
+        try:
+            sock.settimeout(None)  # block for the whole run
+            # Watchdog up BEFORE the upload: a partition mid-send of a
+            # multi-GB board would otherwise block sendall() forever with
+            # nothing watching.
+            if hb_interval > 0:
+                threading.Thread(target=watchdog, daemon=True).start()
+            send_msg(sock, header, world)
+            resp, out = recv_msg(sock)
+        except (ConnectionError, OSError) as e:
+            if lost.is_set():
+                raise ConnectionError(
+                    f"engine heartbeat lost ({hb_misses} misses x "
+                    f"{hb_interval}s)") from e
+            raise
+        finally:
+            stop.set()
+            try:
+                sock.close()
+            except OSError:
+                pass
+        _check_resp(resp)
         return out, int(resp["turn"])
+
+    def ping(self) -> int:
+        resp, _ = self._call({"method": "Ping"}, timeout=self._timeout)
+        return int(resp["turn"])
+
+    def abort_run(self) -> bool:
+        """Stop the engine's current run IF it is this controller's own
+        (token match); returns whether an abort was delivered."""
+        resp, _ = self._call(
+            {"method": "AbortRun", "token": self._token},
+            timeout=self._timeout)
+        return bool(resp.get("aborted"))
 
     def alive_count(self) -> Tuple[int, int]:
         resp, _ = self._call({"method": "Alivecount"},

@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -30,8 +31,20 @@ from gol_tpu.engine import (
 from gol_tpu.io.pgm import input_path, output_path, read_pgm, write_pgm
 from gol_tpu.params import Params
 from gol_tpu.utils.cell import alive_cells_from_board
+from gol_tpu.utils.envcfg import env_float
 
 ALIVE_POLL_SECONDS = 2.0  # reference ticker (`Local/gol/distributor.go:58`)
+
+# GOL_RECONNECT=<seconds>: how long a controller keeps trying to reattach
+# to a lost REMOTE engine before giving up (0 disables). Beyond-reference
+# failure recovery (its controller does `log.Fatal` on dial errors,
+# `Local/gol/distributor.go:96-98`): on connection loss mid-run the
+# controller emits EngineLost, polls ping until the engine answers, then
+# resumes from the engine's authoritative (world, turn) — or resubmits its
+# own last-known board when the engine came back empty (fresh restart
+# without a checkpoint).
+RECONNECT_ENV = "GOL_RECONNECT"
+RECONNECT_DEFAULT = 10.0
 
 # Process-local default engine. A module global on purpose: it outlives
 # individual `run` calls, which is what makes in-process detach/reattach
@@ -62,6 +75,21 @@ def _sub_workers() -> List[str]:
     if not sub:
         return []
     return [a for a in sub.split(",") if a]
+
+
+def _await_engine(engine, budget_s: float) -> None:
+    """Poll `engine.ping()` with a short backoff until it answers or the
+    budget runs out (re-raising the last connection error). An EngineKilled
+    answer propagates — a deliberately killed engine is not 'lost'."""
+    deadline = time.monotonic() + budget_s
+    while True:
+        try:
+            engine.ping()
+            return
+        except (ConnectionError, OSError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(min(0.5, budget_s / 10))
 
 
 def distributor(
@@ -120,8 +148,13 @@ def distributor(
                 elif key == "k":
                     kp_state["k"] = True
                     engine.cf_put(FLAG_KILL)
-            except (EngineKilled, ConnectionError, OSError):
+            except EngineKilled:
                 return
+            except (ConnectionError, OSError):
+                # Engine outage: drop this keypress but keep serving — the
+                # run loop may reattach (GOL_RECONNECT) and later keys must
+                # still work.
+                continue
             except RuntimeError:
                 # Transient engine state (e.g. snapshot requested before the
                 # board is loaded) — drop this keypress, keep serving.
@@ -132,8 +165,10 @@ def distributor(
         while not done.wait(ALIVE_POLL_SECONDS):
             try:
                 alive, turn = engine.alive_count()
-            except (EngineKilled, ConnectionError, OSError):
+            except EngineKilled:
                 return
+            except (ConnectionError, OSError):
+                continue  # engine outage: resume ticking after reattach
             events_q.put(ev.AliveCellsCount(turn, alive))
 
     # -- live view feed: CellsFlipped diffs + TurnComplete ----------------
@@ -181,19 +216,95 @@ def distributor(
 
         events_q.put(ev.StateChange(start_turn, ev.State.EXECUTING))
 
-        # -- blocking run (`:182`) ----------------------------------------
-        run_params = Params(
-            threads=p.threads,
-            image_width=width,
-            image_height=height,
-            turns=turns_left,
-        )
-        try:
-            final_world, final_turn = engine.server_distributor(
-                run_params, world, _sub_workers(), start_turn=start_turn
+        # -- blocking run (`:182`), with reattach-on-loss -----------------
+        # Recovery only for engines whose ConnectionError/OSError means
+        # the NETWORK/peer (RemoteEngine sets `recoverable`): an in-process
+        # engine's OSError (e.g. full disk during checkpointing) must
+        # propagate, not be mistaken for a lost connection.
+        reconnect_budget = env_float(RECONNECT_ENV, RECONNECT_DEFAULT)
+        recoverable = (
+            reconnect_budget > 0 and getattr(engine, "recoverable", False))
+        lost_pending = False       # a loss episode awaits its Reattached
+        recovery_deadline = None   # bound on one recovery episode
+        while True:
+            run_params = Params(
+                threads=p.threads,
+                image_width=width,
+                image_height=height,
+                turns=turns_left,
             )
-        except EngineKilled:
-            final_world, final_turn = world, start_turn
+            submit_t = time.monotonic()
+            try:
+                final_world, final_turn = engine.server_distributor(
+                    run_params, world, _sub_workers(), start_turn=start_turn
+                )
+                break
+            except EngineKilled:
+                final_world, final_turn = world, start_turn
+                break
+            except (ConnectionError, OSError):
+                if not recoverable:
+                    raise
+                now = time.monotonic()
+                if now - submit_t > reconnect_budget:
+                    # The (re)submitted run made real wall-clock progress
+                    # before failing: a NEW outage, not the old episode
+                    # still flapping — grant it a fresh budget.
+                    recovery_deadline = None
+                if recovery_deadline is None:
+                    recovery_deadline = now + reconnect_budget
+                elif now >= recovery_deadline:
+                    raise  # episode budget exhausted — stop flapping
+                else:
+                    time.sleep(0.1)  # damp a flapping link's retry spin
+                if not lost_pending:
+                    events_q.put(ev.EngineLost(start_turn))
+                    lost_pending = True
+                try:
+                    _await_engine(
+                        engine, max(recovery_deadline - now, 0.0))
+                except EngineKilled:
+                    final_world, final_turn = world, start_turn
+                    break
+            except RuntimeError as e:
+                # "already running": after a TRANSIENT partition the server
+                # never saw the dead socket, so this run's pre-partition
+                # orphan still occupies the engine. abort_run is
+                # token-scoped — it stops OUR orphan and is a no-op on a
+                # foreign controller's run, which then keeps failing the
+                # resubmit until the episode deadline re-raises here.
+                if not (recovery_deadline is not None
+                        and "already running" in str(e)
+                        and hasattr(engine, "abort_run")):
+                    raise
+                if time.monotonic() >= recovery_deadline:
+                    raise
+                try:
+                    engine.abort_run()
+                except EngineKilled:
+                    final_world, final_turn = world, start_turn
+                    break
+                except (ConnectionError, OSError, RuntimeError):
+                    pass
+                time.sleep(0.3)
+
+            # -- reattach: refresh state, then resubmit ------------------
+            try:
+                # Engine is back with authoritative state (it survived, or
+                # was restarted from a checkpoint): resume from it.
+                world, start_turn = engine.get_world()
+            except EngineKilled:
+                final_world, final_turn = world, start_turn
+                break
+            except (RuntimeError, ConnectionError, OSError):
+                # Engine restarted empty (or flapped again between ping
+                # and snapshot): resubmit the last-known board from the
+                # last-known turn — deterministic re-evolution.
+                pass
+            turns_left = max(p.turns - start_turn, 0)
+            if lost_pending:
+                events_q.put(ev.EngineReattached(start_turn))
+                lost_pending = False
 
         # -- finalize (`:187-226`) ----------------------------------------
         alive_cells = alive_cells_from_board(final_world)

@@ -50,6 +50,7 @@ from gol_tpu.ops.stencil import alive_count_exact, from_pixels, to_pixels
 from gol_tpu.params import Params
 from gol_tpu.parallel.halo import select_representation, shard_board
 from gol_tpu.parallel.mesh import make_mesh, resolve_shard_count
+from gol_tpu.utils.envcfg import env_float, env_int
 from gol_tpu.utils.sync import wait
 
 # Control-flag wire values (reference Cf.Flag).
@@ -59,6 +60,11 @@ FLAG_KILL = 5
 
 CHUNK_TARGET_SECONDS = 0.15
 MAX_CHUNK = 1 << 20
+# GOL_MAX_CHUNK=<n>: cap the adaptive chunk size. Bounds worst-case
+# pause/quit/snapshot latency (and checkpoint staleness) at the cost of
+# throughput; also the fault-injection tests' way of keeping an engine
+# slow enough to kill mid-run deterministically.
+MAX_CHUNK_ENV = "GOL_MAX_CHUNK"
 
 # GOL_TRACE=<dir>: dump one jax.profiler trace of a representative chunk
 # per run — the counterpart of the reference's runtime/trace TestTrace
@@ -133,9 +139,13 @@ class Engine:
         self._flags: "queue.Queue[int]" = queue.Queue()
         self._killed = False
         self._running = False
+        # Owner token of the current run + its abort signal (abort_run).
+        self._run_token: Optional[str] = None
+        self._abort = threading.Event()
         # Dispatch-floor estimate for the chunk adapter (min elapsed ever
         # observed for a full chunk); engine-lifetime, it only sharpens.
         self._fixed_cost_est = float("inf")
+        self._max_chunk = MAX_CHUNK
 
     # ------------------------------------------------------------------ RPC
 
@@ -145,6 +155,7 @@ class Engine:
         world: np.ndarray,
         sub_workers: Sequence[str] = (),
         start_turn: int = 0,
+        token: Optional[str] = None,
     ) -> Tuple[np.ndarray, int]:
         """Blocking run: evolve `world` for `params.turns` turns, honouring
         control flags between chunks. Returns ({0,255} board, completed turn).
@@ -152,7 +163,10 @@ class Engine:
         `sub_workers` mirrors the reference's worker-address list
         (`SUB`, `Local/gol/distributor.go:100-105`): its length is the
         requested shard count. `start_turn` carries the resume arithmetic
-        explicitly (the reference keeps it in a broker global).
+        explicitly (the reference keeps it in a broker global). `token`
+        identifies the submitting controller so `abort_run` can stop an
+        orphaned run of the SAME controller after a transient partition
+        without being able to touch anyone else's.
         """
         self._check_alive()
         if self._running:
@@ -187,14 +201,16 @@ class Engine:
             self._packed = packed
             self._turn = start_turn
             self._running = True
+            self._run_token = token
+            self._abort.clear()
 
         target = start_turn + params.turns
         chunk = 1
+        self._max_chunk = env_int(MAX_CHUNK_ENV, MAX_CHUNK)
         quit_run = False
         trace_dir = os.environ.get(TRACE_ENV, "")
         ckpt_dir = os.environ.get(CKPT_ENV, "")
-        ckpt_every = float(
-            os.environ.get(CKPT_EVERY_ENV, CKPT_EVERY_DEFAULT))
+        ckpt_every = env_float(CKPT_EVERY_ENV, CKPT_EVERY_DEFAULT)
         ckpt_path = ""
         if ckpt_dir:
             os.makedirs(ckpt_dir, exist_ok=True)
@@ -203,7 +219,7 @@ class Engine:
         chunks_done = 0
         try:
             while self._turn < target and not quit_run:
-                if self._killed:
+                if self._killed or self._abort.is_set():
                     break
                 k = _next_chunk(chunk, target - self._turn)
                 # Trace the second chunk (first is compile-warmup), or the
@@ -240,6 +256,8 @@ class Engine:
         finally:
             with self._state_lock:
                 self._running = False
+                self._run_token = None
+                self._abort.clear()
         # On kill_prog mid-run, still hand back the partial board — the
         # state exists and discarding completed turns helps nobody; further
         # RPCs on this engine raise EngineKilled.
@@ -285,6 +303,31 @@ class Engine:
     def kill_prog(self) -> None:
         """Mark the engine dead (ref `Server:77-80`, worker os.Exit)."""
         self._killed = True
+
+    def abort_run(self, token: Optional[str] = None) -> bool:
+        """Stop the current run iff `token` matches the run owner's —
+        the recovery takeover after a transient partition (the controller
+        resubmits, finds its pre-partition orphan still computing, and
+        reclaims the engine). No reference counterpart: the Go broker has
+        no way to be reclaimed by a controller that lost it. No-op (False)
+        when idle or when the run belongs to another controller; on abort
+        the state is preserved at the stop point exactly like FLAG_QUIT."""
+        self._check_alive()
+        with self._state_lock:
+            if self._running and self._run_token == token:
+                self._abort.set()
+                return True
+            return False
+
+    def ping(self) -> int:
+        """Liveness probe: the completed turn, with no device work — cheap
+        enough for a sub-second heartbeat. Beyond-reference addition (the
+        reference has no failure detection, SURVEY §5); a killed engine
+        still answers (with EngineKilled), distinguishing 'deliberately
+        down' from 'lost'."""
+        self._check_alive()
+        with self._state_lock:
+            return self._turn
 
     # -------------------------------------------------------- checkpointing
 
@@ -377,7 +420,7 @@ class Engine:
             return chunk  # partial (remainder) chunk — timing unrepresentative
         self._fixed_cost_est = min(self._fixed_cost_est, elapsed)
         marginal = elapsed - self._fixed_cost_est
-        if marginal < CHUNK_TARGET_SECONDS and chunk < MAX_CHUNK:
+        if marginal < CHUNK_TARGET_SECONDS and chunk < self._max_chunk:
             return chunk * 2
         if marginal > CHUNK_TARGET_SECONDS * 2 and chunk > 1:
             return chunk // 2
@@ -388,7 +431,7 @@ class Engine:
         (reference handshake `Server/gol/distributor.go:136-164`)."""
         paused = False
         while True:
-            if self._killed:
+            if self._killed or self._abort.is_set():
                 return True
             try:
                 flag = self._flags.get_nowait() if not paused \

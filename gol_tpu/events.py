@@ -97,6 +97,28 @@ class FinalTurnComplete(Event):
     alive: Tuple[Tuple[int, int], ...] = ()  # (x, y) pairs
 
 
+@dataclasses.dataclass(frozen=True)
+class EngineLost(Event):
+    """The controller lost its remote engine mid-run (connection failure
+    or missed heartbeats). Framework extension beyond the reference —
+    its only failure story is `log.Fatal` on dial errors
+    (`Local/gol/distributor.go:96-98`); here the controller announces the
+    loss and tries to reattach (see `GOL_RECONNECT`)."""
+
+    def __str__(self) -> str:
+        return "Engine connection lost"
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineReattached(Event):
+    """The controller reattached to a recovered engine and resumed the run
+    from the engine's authoritative (world, turn) — the automated version
+    of the reference's manual CONT=yes reattach."""
+
+    def __str__(self) -> str:
+        return "Engine connection restored"
+
+
 class _Close:
     """Sentinel marking the end of the event stream (Go channel close)."""
 

@@ -86,8 +86,14 @@ class EngineServer:
                     world,
                     tuple(header.get("sub_workers", ())),
                     start_turn=int(header.get("start_turn", 0)),
+                    token=header.get("token"),
                 )
                 send_msg(conn, {"ok": True, "turn": turn}, out)
+            elif method == "AbortRun":
+                aborted = self.engine.abort_run(header.get("token"))
+                send_msg(conn, {"ok": True, "aborted": aborted})
+            elif method == "Ping":
+                send_msg(conn, {"ok": True, "turn": self.engine.ping()})
             elif method == "Alivecount":
                 alive, turn = self.engine.alive_count()
                 send_msg(conn, {"ok": True, "alive": alive, "turn": turn})

@@ -1,0 +1,553 @@
+"""Failure detection and recovery — beyond-reference subsystem (SURVEY §5
+lists the reference's story as `log.Fatal` on dial errors plus manual
+CONT=yes reattach). Covered here:
+
+- liveness probe (Ping) over the control plane
+- heartbeat watchdog converting a silently hung run connection into a
+  prompt ConnectionError
+- controller auto-reattach: EngineLost -> ping poll -> resume from the
+  engine's authoritative state (or resubmit when it came back empty)
+- full cross-process story: SIGKILL the engine server mid-run, restart it
+  from its periodic checkpoint, controller reattaches and finishes
+"""
+
+import os
+import queue
+import re
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import Params, events as ev
+from gol_tpu.client import RemoteEngine
+from gol_tpu.distributor import distributor
+from gol_tpu.engine import Engine
+from gol_tpu.io.pgm import read_pgm
+from gol_tpu.ops.reference import run_turns_np
+from gol_tpu.server import EngineServer
+
+
+@pytest.fixture
+def server(monkeypatch):
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def test_ping_roundtrip(server):
+    eng = RemoteEngine(f"127.0.0.1:{server.port}")
+    assert eng.ping() == 0
+    world = np.zeros((16, 16), dtype=np.uint8)
+    world[4:7, 5] = 255
+    p = Params(threads=1, image_width=16, image_height=16, turns=8)
+    eng.server_distributor(p, world)
+    assert eng.ping() == 8
+
+
+def test_new_event_strings():
+    assert str(ev.EngineLost(7)) == "Engine connection lost"
+    assert str(ev.EngineReattached(7)) == "Engine connection restored"
+    assert ev.EngineReattached(7).completed_turns == 7
+
+
+def test_heartbeat_unblocks_hung_connection(monkeypatch):
+    """A server that accepts the run call and then goes silent (partition,
+    wedged host) must not block the controller forever: the heartbeat
+    watchdog closes the run socket after GOL_HB_MISSES failed pings."""
+    monkeypatch.setenv("GOL_HB_INTERVAL", "0.2")
+    monkeypatch.setenv("GOL_HB_MISSES", "2")
+
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(16)
+    port = lsock.getsockname()[1]
+    stop = threading.Event()
+    conns = []
+
+    def silent_server():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            conns.append(conn)  # read nothing, reply nothing
+
+    threading.Thread(target=silent_server, daemon=True).start()
+    try:
+        eng = RemoteEngine(f"127.0.0.1:{port}", timeout=0.3)
+        world = np.zeros((16, 16), dtype=np.uint8)
+        p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="heartbeat lost"):
+            eng.server_distributor(p, world)
+        assert time.monotonic() - t0 < 30, "watchdog took implausibly long"
+    finally:
+        stop.set()
+        lsock.close()
+        for c in conns:
+            c.close()
+
+
+class FlakyEngine:
+    """Wraps a real Engine. The first run call advances `die_after` turns
+    and then raises ConnectionError (the crash); every later call passes
+    through. With `amnesia=True`, the first get_world after the crash
+    raises RuntimeError — an engine restarted without state."""
+
+    recoverable = True  # opt in to the distributor's reconnect logic
+
+    def __init__(self, inner: Engine, die_after: int, amnesia: bool = False):
+        self.inner = inner
+        self.die_after = die_after
+        self.amnesia = amnesia
+        self.crashed = False
+
+    def server_distributor(self, params, world, sub_workers=(),
+                           start_turn=0):
+        if not self.crashed:
+            self.crashed = True
+            partial = Params(
+                threads=params.threads,
+                image_width=params.image_width,
+                image_height=params.image_height,
+                turns=self.die_after,
+            )
+            self.inner.server_distributor(
+                partial, world, sub_workers, start_turn=start_turn)
+            raise ConnectionError("simulated engine crash")
+        return self.inner.server_distributor(
+            params, world, sub_workers, start_turn=start_turn)
+
+    def get_world(self):
+        if self.amnesia:
+            self.amnesia = False
+            raise RuntimeError("engine error: no board loaded")
+        return self.inner.get_world()
+
+    def ping(self):
+        return self.inner.ping()
+
+    def alive_count(self):
+        return self.inner.alive_count()
+
+    def cf_put(self, flag):
+        return self.inner.cf_put(flag)
+
+    def drain_flags(self):
+        return self.inner.drain_flags()
+
+    def abort_run(self):
+        return self.inner.abort_run()
+
+    def kill_prog(self):
+        return self.inner.kill_prog()
+
+
+def _alive_board(final, shape):
+    board = np.zeros(shape, dtype=np.uint8)
+    for x, y in final.alive:
+        board[y, x] = 1
+    return board
+
+
+@pytest.mark.parametrize("amnesia", [False, True])
+def test_controller_recovers_from_engine_loss(
+    amnesia, images_dir, out_dir, monkeypatch
+):
+    """Deterministic in-process fault injection: the engine 'crashes' at
+    turn 30 of 100. With state surviving (amnesia=False) the controller
+    resumes from turn 30; restarted empty (amnesia=True) it resubmits its
+    own board from turn 0. Either way the final board must equal an
+    uninterrupted 100-turn run."""
+    monkeypatch.setenv("GOL_RECONNECT", "5")
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    monkeypatch.delenv("SUB", raising=False)
+
+    eng = FlakyEngine(Engine(), die_after=30, amnesia=amnesia)
+    p = Params(threads=2, image_width=64, image_height=64, turns=100)
+    q = queue.Queue()
+    distributor(p, q, None, engine=eng,
+                images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(q)
+
+    lost = [e for e in evs if isinstance(e, ev.EngineLost)]
+    back = [e for e in evs if isinstance(e, ev.EngineReattached)]
+    assert len(lost) == 1 and len(back) == 1
+    assert evs.index(lost[0]) < evs.index(back[0])
+    assert back[0].completed_turns == (0 if amnesia else 30)
+
+    final = [e for e in evs if isinstance(e, ev.FinalTurnComplete)][0]
+    assert final.completed_turns == 100
+    world0 = (read_pgm(os.path.join(images_dir, "64x64.pgm")) != 0
+              ).astype(np.uint8)
+    want = run_turns_np(world0, 100)
+    np.testing.assert_array_equal(_alive_board(final, want.shape), want)
+
+
+class PartitionEngine:
+    """Simulates a TRANSIENT partition: the first run call starts the real
+    run in a background thread (the server side never saw the dead socket,
+    so the engine keeps computing) and raises ConnectionError. Recovery
+    must abort the orphaned run and resume from its preserved state."""
+
+    recoverable = True
+
+    def __init__(self, inner: Engine):
+        self.inner = inner
+        self.partitioned = False
+        self.aborts = 0
+        self.flags_seen = []
+
+    def server_distributor(self, params, world, sub_workers=(),
+                           start_turn=0):
+        if not self.partitioned:
+            self.partitioned = True
+            threading.Thread(
+                target=self.inner.server_distributor,
+                args=(params, world, sub_workers),
+                kwargs=dict(start_turn=start_turn),
+                daemon=True,
+            ).start()
+            time.sleep(0.5)  # let the orphan get going
+            raise ConnectionError("simulated partition")
+        return self.inner.server_distributor(
+            params, world, sub_workers, start_turn=start_turn)
+
+    def cf_put(self, flag):
+        self.flags_seen.append(flag)
+        return self.inner.cf_put(flag)
+
+    def abort_run(self):
+        self.aborts += 1
+        return self.inner.abort_run()
+
+    def get_world(self):
+        return self.inner.get_world()
+
+    def ping(self):
+        return self.inner.ping()
+
+    def alive_count(self):
+        return self.inner.alive_count()
+
+    def drain_flags(self):
+        return self.inner.drain_flags()
+
+    def kill_prog(self):
+        return self.inner.kill_prog()
+
+
+def test_recovery_quits_orphaned_run(images_dir, out_dir, monkeypatch):
+    """Transient-partition recovery: the resubmit hits 'engine already
+    running a board'; the controller must abort the orphan (token-scoped
+    abort_run) and resume from its stop-point state, finishing exactly."""
+    monkeypatch.setenv("GOL_RECONNECT", "60")
+    monkeypatch.setenv("GOL_MAX_CHUNK", "4")  # slow, flag-responsive engine
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    monkeypatch.delenv("SUB", raising=False)
+
+    turns = 8000
+    eng = PartitionEngine(Engine())
+    p = Params(threads=2, image_width=64, image_height=64, turns=turns)
+    q = queue.Queue()
+    distributor(p, q, None, engine=eng,
+                images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(q)
+
+    assert eng.aborts >= 1, \
+        "recovery never had to abort the orphan (timing too generous?)"
+    assert not eng.flags_seen, "recovery must not touch the flag queue"
+    assert len([e for e in evs if isinstance(e, ev.EngineLost)]) == 1
+    assert len([e for e in evs if isinstance(e, ev.EngineReattached)]) == 1
+
+    final = [e for e in evs if isinstance(e, ev.FinalTurnComplete)][0]
+    assert final.completed_turns == turns
+    world0 = (read_pgm(os.path.join(images_dir, "64x64.pgm")) != 0
+              ).astype(np.uint8)
+    want = run_turns_np(world0, turns)
+    np.testing.assert_array_equal(_alive_board(final, want.shape), want)
+
+
+def test_abort_run_is_token_scoped(monkeypatch):
+    """abort_run must stop only the run submitted with the same token —
+    a foreign controller's token is a no-op."""
+    monkeypatch.setenv("GOL_MAX_CHUNK", "4")
+    eng = Engine()
+    world = np.zeros((16, 16), dtype=np.uint8)
+    world[4:7, 5] = 255
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    t = threading.Thread(
+        target=eng.server_distributor, args=(p, world),
+        kwargs=dict(token="owner"), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    while not eng._running:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    assert eng.abort_run("intruder") is False
+    assert eng.abort_run(None) is False
+    assert t.is_alive()
+    assert eng.abort_run("owner") is True
+    t.join(30)
+    assert not t.is_alive()
+    assert eng.abort_run("owner") is False  # idle engine: no-op
+
+
+def test_abort_run_over_the_wire(server, monkeypatch):
+    """AbortRun via the TCP control plane: only the submitting
+    RemoteEngine (same token) can stop the run."""
+    monkeypatch.setenv("GOL_MAX_CHUNK", "4")
+    owner = RemoteEngine(f"127.0.0.1:{server.port}")
+    other = RemoteEngine(f"127.0.0.1:{server.port}")
+    world = np.zeros((16, 16), dtype=np.uint8)
+    world[4:7, 5] = 255
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    result = {}
+
+    def blocking_run():
+        result["out"], result["turn"] = owner.server_distributor(p, world)
+
+    t = threading.Thread(target=blocking_run, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    while owner.ping() == 0:
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    assert other.abort_run() is False
+    assert t.is_alive()
+    assert owner.abort_run() is True
+    t.join(30)
+    assert not t.is_alive()
+    assert 0 < result["turn"] < 10**8
+
+
+class FlappingEngine:
+    """Pings fine, but every run submission dies mid-flight — a link that
+    flaps forever. Recovery must give up within the episode budget."""
+
+    recoverable = True
+
+    def __init__(self):
+        self.attempts = 0
+
+    def server_distributor(self, *a, **k):
+        self.attempts += 1
+        raise ConnectionError("flap")
+
+    def ping(self):
+        return 0
+
+    def get_world(self):
+        raise RuntimeError("no board loaded")
+
+    def alive_count(self):
+        return (0, 0)
+
+    def cf_put(self, flag):
+        pass
+
+    def drain_flags(self):
+        pass
+
+    def abort_run(self):
+        return False
+
+    def kill_prog(self):
+        pass
+
+
+def test_flapping_link_gives_up_within_budget(images_dir, out_dir,
+                                              monkeypatch):
+    monkeypatch.setenv("GOL_RECONNECT", "1.5")
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    eng = FlappingEngine()
+    p = Params(threads=2, image_width=64, image_height=64, turns=100)
+    q = queue.Queue()
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        distributor(p, q, None, engine=eng,
+                    images_dir=images_dir, out_dir=out_dir)
+    assert time.monotonic() - t0 < 30
+    assert 2 <= eng.attempts <= 60, "retries must be damped AND bounded"
+    evs = ev.drain(q)
+    lost = len([e for e in evs if isinstance(e, ev.EngineLost)])
+    back = len([e for e in evs if isinstance(e, ev.EngineReattached)])
+    # Contact genuinely flaps, so Lost/Reattached come in bounded pairs —
+    # the last loss has no matching reattach (that is the give-up).
+    assert lost - back in (0, 1) and lost <= 60
+
+
+def test_reconnect_disabled_propagates(images_dir, out_dir, monkeypatch):
+    monkeypatch.setenv("GOL_RECONNECT", "0")
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    eng = FlakyEngine(Engine(), die_after=10)
+    p = Params(threads=2, image_width=64, image_height=64, turns=100)
+    q = queue.Queue()
+    with pytest.raises(ConnectionError):
+        distributor(p, q, None, engine=eng,
+                    images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(q)  # CLOSE still delivered (finally block)
+    assert not [e for e in evs if isinstance(e, ev.EngineLost)]
+
+
+def _spawn_server(port: int, tmp_path, extra_env=None, resume=""):
+    """EngineServer subprocess on the virtual CPU mesh (site hook beats
+    env vars, so the platform is forced via jax.config — same bootstrap as
+    tests/conftest.py)."""
+    argv = ["server", "--port", str(port)]
+    if resume:
+        argv += ["--resume", resume]
+    launcher = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
+        "' --xla_force_host_platform_device_count=8'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import sys\n"
+        f"sys.argv = {argv!r}\n"
+        "from gol_tpu.server import main\n"
+        "main()\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("SER", None)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-u", "-c", launcher],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=str(tmp_path),
+    )
+
+
+def _wait_port(proc, timeout=120):
+    found = {}
+
+    def scan():
+        for line in proc.stdout:
+            m = re.search(r"serving on :(\d+)", line)
+            if m:
+                found["port"] = int(m.group(1))
+                return
+
+    t = threading.Thread(target=scan, daemon=True)
+    t.start()
+    t.join(timeout)
+    return found.get("port")
+
+
+def test_sigkill_restart_resume_e2e(images_dir, out_dir, tmp_path,
+                                    monkeypatch):
+    """The full failure-recovery story across real process boundaries:
+    engine server SIGKILLed mid-run; controller emits EngineLost and polls;
+    a replacement server restores the periodic checkpoint (--resume); the
+    controller reattaches, resumes, and the final board is exact."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt_path = os.path.join(ckpt_dir, "64x64.npz")
+    server_env = {
+        "GOL_CKPT": ckpt_dir,
+        "GOL_CKPT_EVERY": "0.3",
+        "GOL_MAX_CHUNK": "16",  # keep the engine slow + checkpoints fresh
+    }
+    proc1 = _spawn_server(0, tmp_path, extra_env=server_env)
+    proc2 = None
+    collected = []
+    closed = threading.Event()
+    try:
+        port = _wait_port(proc1)
+        assert port, "server 1 never announced its port"
+
+        monkeypatch.setenv("SER", f"127.0.0.1:{port}")
+        monkeypatch.setenv("GOL_RECONNECT", "180")
+        monkeypatch.setenv("GOL_HB_INTERVAL", "0.3")
+        monkeypatch.setenv("GOL_HB_MISSES", "2")
+        monkeypatch.delenv("CONT", raising=False)
+        monkeypatch.delenv("SUB", raising=False)
+
+        p = Params(threads=2, image_width=64, image_height=64, turns=10**8)
+        q, keys = queue.Queue(), queue.Queue()
+
+        def collect():
+            while True:
+                e = q.get()
+                if e is ev.CLOSE:
+                    closed.set()
+                    return
+                collected.append(e)
+
+        threading.Thread(target=collect, daemon=True).start()
+        ctrl = threading.Thread(
+            target=distributor,
+            args=(p, q, keys),
+            kwargs=dict(images_dir=images_dir, out_dir=out_dir),
+            daemon=True,
+        )
+        ctrl.start()
+
+        # Let the run get going and a checkpoint land on disk.
+        deadline = time.monotonic() + 60
+        while not os.path.exists(ckpt_path):
+            assert time.monotonic() < deadline, "no checkpoint appeared"
+            time.sleep(0.2)
+        time.sleep(1.0)  # at least one post-first checkpoint cycle
+
+        os.kill(proc1.pid, signal.SIGKILL)
+        proc1.wait(10)
+
+        deadline = time.monotonic() + 60
+        while not any(isinstance(e, ev.EngineLost) for e in collected):
+            assert time.monotonic() < deadline, "EngineLost never emitted"
+            assert ctrl.is_alive(), "controller died instead of recovering"
+            time.sleep(0.1)
+
+        # Replacement engine on the SAME port, restored from checkpoint.
+        proc2 = _spawn_server(port, tmp_path, extra_env=server_env,
+                              resume=ckpt_path)
+        deadline = time.monotonic() + 150
+        while not any(isinstance(e, ev.EngineReattached)
+                      for e in collected):
+            assert time.monotonic() < deadline, "controller never reattached"
+            assert ctrl.is_alive()
+            time.sleep(0.2)
+        reatt = [e for e in collected
+                 if isinstance(e, ev.EngineReattached)][0]
+
+        keys.put("q")  # detach: the blocking run returns promptly
+        ctrl.join(60)
+        assert not ctrl.is_alive(), "controller did not finish after 'q'"
+        assert closed.wait(10)
+
+        final = [e for e in collected
+                 if isinstance(e, ev.FinalTurnComplete)][0]
+        assert final.completed_turns >= reatt.completed_turns > 0
+
+        # Exactness: replay the whole run on the host oracle. The engine is
+        # capped at 16-turn chunks so the turn count stays replayable.
+        world0 = (read_pgm(os.path.join(images_dir, "64x64.pgm")) != 0
+                  ).astype(np.uint8)
+        want = run_turns_np(world0, final.completed_turns)
+        np.testing.assert_array_equal(
+            _alive_board(final, want.shape), want)
+    finally:
+        for proc in (proc1, proc2):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                proc.wait(10)
