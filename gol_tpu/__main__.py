@@ -1,0 +1,9 @@
+"""`python -m gol_tpu` — same CLI as `python -m gol_tpu.main` and the
+`gol-tpu` console script (reference counterpart: the `Local/` binary)."""
+
+import sys
+
+from gol_tpu.main import main
+
+if __name__ == "__main__":
+    sys.exit(main())

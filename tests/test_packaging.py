@@ -1,0 +1,33 @@
+"""Packaging surface: console entry points resolve and the module CLI
+answers — the `go build` story of the reference replaced by a pip
+install."""
+
+import importlib
+import os
+import subprocess
+import sys
+
+import tomllib
+
+
+def test_console_entry_points_resolve(repo_root):
+    with open(repo_root / "pyproject.toml", "rb") as f:
+        cfg = tomllib.load(f)
+    scripts = cfg["project"]["scripts"]
+    assert set(scripts) == {"gol-tpu", "gol-tpu-server"}
+    for target in scripts.values():
+        mod, _, attr = target.partition(":")
+        assert callable(getattr(importlib.import_module(mod), attr))
+
+
+def test_python_m_gol_tpu_help(repo_root):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(repo_root) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "gol_tpu", "--help"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(repo_root),
+    )
+    assert out.returncode == 0
+    assert "Game of Life" in out.stdout
