@@ -1,0 +1,76 @@
+"""Worker process for the 2-process multi-host e2e test
+(`test_multihost.py`). Joins the cluster through the framework's own
+`parallel.multihost.initialize` (GOL_COORDINATOR env contract), builds an
+8-shard mesh spanning BOTH processes (4 virtual CPU devices each), runs
+the sharded ppermute-halo evolution, and verifies every locally
+addressable shard against the independent numpy oracle — the TPU-native
+counterpart of the reference's multi-node broker/worker deployment
+(`Local/gol/distributor.go:100-105`, SURVEY §2d)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["GOL_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["GOL_NUM_PROCS"] = "2"
+    os.environ["GOL_PROC_ID"] = str(pid)
+
+    from gol_tpu.parallel import multihost
+
+    assert multihost.initialize(), "initialize() returned single-host"
+    assert multihost.is_multihost()
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8, "mesh must span both processes"
+
+    import numpy as np
+
+    from gol_tpu.ops.reference import run_turns_np
+    from gol_tpu.parallel.halo import sharded_run_turns
+    from gol_tpu.parallel.mesh import board_sharding, make_mesh
+
+    n, turns = 64, 8
+    rng = np.random.default_rng(0)
+    board = (rng.random((n, n)) < 0.3).astype(np.uint8)
+
+    mesh = make_mesh(8, jax.devices())
+    sharding = board_sharding(mesh)
+    arr = jax.make_array_from_callback(
+        (n, n), sharding, lambda idx: board[idx])
+    out = sharded_run_turns(arr, turns, mesh)
+
+    want = run_turns_np(board, turns)
+    shards = list(out.addressable_shards)
+    assert shards, "process owns no shards?"
+    for s in shards:
+        np.testing.assert_array_equal(np.asarray(s.data), want[s.index])
+
+    # Bit-packed path too: deep-halo macro-stepping under shard_map with
+    # the ppermute ring spanning the process boundary.
+    from gol_tpu.ops.bitpack import pack, unpack
+    from gol_tpu.parallel.halo import sharded_packed_run_turns
+
+    packed_np = np.asarray(pack(board))
+    parr = jax.make_array_from_callback(
+        packed_np.shape, board_sharding(mesh),
+        lambda idx: packed_np[idx])
+    pout = sharded_packed_run_turns(parr, turns, mesh)
+    for s in pout.addressable_shards:
+        got = np.asarray(unpack(np.asarray(s.data)))
+        np.testing.assert_array_equal(got, want[s.index])
+
+    print(f"MULTIHOST_OK proc {pid} ({len(shards)} local shards)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
