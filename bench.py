@@ -46,7 +46,7 @@ BASELINE_CUPS = 2.6e7  # see module docstring
 # Per-config default turns: device compute ≈ 10x the ~110 ms fixed
 # dispatch latency (512² at 0.2 µs/turn, 5120² at ~0.42 ms/turn, 65536²
 # at ~5.9 ms/turn measured r1/r2).
-DEFAULT_TURNS = {512: 2_000_000, 5120: 8_000, 65536: 384}
+DEFAULT_TURNS = {512: 2_000_000, 5120: 8_000, 65536: 512}
 SPARSE_TURNS = 8_192
 
 

@@ -76,8 +76,12 @@ def test_band_rows_policy():
     # A band shorter than the halo depth would wrap inside one DMA piece
     # and read out of bounds — such heights must be rejected.
     assert _band_rows(8, 128) == 0
-    assert _band_rows(8168, 128) == 0        # 8*1021; only divisor 8 < 16
+    # 8168 = 8*1021: its only sub-height divisors are < BAND_T, but the
+    # whole height fits the window budget as a single band (grid of 1).
+    assert _band_rows(8168, 128) == 8168
     assert _band_rows(4096, 128) >= BAND_T
+    # Budget-limited flagship: 65536-wide picks the swept 1024-row band.
+    assert _band_rows(65536, 2048) == 1024
 
 
 def test_banded_interpret_matches_jnp():
