@@ -54,16 +54,45 @@ _default_engine: Optional[Engine] = None
 _default_engine_lock = threading.Lock()
 
 
-def _resolve_engine():
+def _resolve_rule(rule=None):
+    """The life-like rule for in-process engines: an explicit argument
+    wins, else GOL_RULE env (e.g. 'B36/S23' for HighLife), default Conway.
+    A malformed rulestring raises — silently defaulting would corrupt a
+    run. Beyond-reference: the Go kernel hardcodes Conway
+    (`SubServer/distributor.go:179-201`)."""
+    from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+
+    if rule is not None:
+        return rule
+    s = os.environ.get("GOL_RULE", "")
+    return LifeLikeRule(s) if s else CONWAY
+
+
+def _resolve_engine(rule=None):
     ser = os.environ.get("SER", "")
     if ser:
         from gol_tpu.client import RemoteEngine
 
         return RemoteEngine(ser)
+    rule = _resolve_rule(rule)
     global _default_engine
     with _default_engine_lock:
         if _default_engine is None or _default_engine._killed:
-            _default_engine = Engine()
+            _default_engine = Engine(rule=rule)
+        elif _default_engine._rule != rule:
+            if _default_engine._cells is not None:
+                # The engine holds detached (world, turn) state — the
+                # CONT=yes contract. Its own rule stays authoritative
+                # (same stance as the checkpoint rule guard); a rebuild
+                # here would silently discard the board.
+                import warnings
+
+                warnings.warn(
+                    f"engine holds a detached board under rule "
+                    f"{_default_engine._rule.rulestring}; ignoring "
+                    f"requested rule {rule.rulestring}")
+            else:
+                _default_engine = Engine(rule=rule)
         return _default_engine
 
 
@@ -100,10 +129,11 @@ def distributor(
     images_dir: Optional[str] = None,
     out_dir: Optional[str] = None,
     live_view: bool = False,
+    rule=None,
 ) -> None:
     images_dir = images_dir or os.environ.get("GOL_IMAGES", "images")
     out_dir = out_dir or os.environ.get("GOL_OUT", "out")
-    engine = engine if engine is not None else _resolve_engine()
+    engine = engine if engine is not None else _resolve_engine(rule)
 
     width, height = p.image_width, p.image_height
     done = threading.Event()

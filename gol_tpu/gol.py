@@ -26,11 +26,12 @@ def run(
     images_dir: Optional[str] = None,
     out_dir: Optional[str] = None,
     live_view: bool = False,
+    rule=None,
 ) -> threading.Thread:
     t = threading.Thread(
         target=distributor,
         args=(p, events, key_presses, engine, images_dir, out_dir,
-              live_view),
+              live_view, rule),
         daemon=True,
         name="gol-distributor",
     )

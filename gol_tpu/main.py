@@ -37,6 +37,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="enable the live board view (polls snapshots)")
     ap.add_argument("--trace", metavar="DIR", default="",
                     help="dump one jax.profiler chunk trace to DIR")
+    ap.add_argument("--rule", metavar="B.../S...", default="",
+                    help="life-like rulestring for the in-process engine "
+                         "(e.g. B36/S23 = HighLife; default Conway). With "
+                         "SER set, the remote engine's own rule governs.")
     return ap.parse_args(argv)
 
 
@@ -48,6 +52,11 @@ def main(argv=None) -> int:
         from gol_tpu.engine import TRACE_ENV
 
         os.environ[TRACE_ENV] = args.trace
+    rule = None
+    if args.rule:
+        from gol_tpu.models.lifelike import LifeLikeRule
+
+        rule = LifeLikeRule(args.rule)  # fail fast on a malformed string
     p = Params(
         threads=args.threads,
         image_width=args.width,
@@ -56,7 +65,7 @@ def main(argv=None) -> int:
     )
     events_q: "queue.Queue" = queue.Queue(maxsize=10000)
     key_presses: "queue.Queue" = queue.Queue(maxsize=10)
-    run(p, events_q, key_presses, live_view=args.live)
+    run(p, events_q, key_presses, live_view=args.live, rule=rule)
     view_start(p, events_q, key_presses, headless=args.headless)
     return 0
 

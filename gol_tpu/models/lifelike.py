@@ -25,10 +25,17 @@ class LifeLikeRule:
     rulestring: str = "B3/S23"
 
     def __post_init__(self) -> None:
-        if _RULE_RE.match(self.rulestring) is None:
+        m = _RULE_RE.match(self.rulestring)
+        if m is None:
             raise ValueError(
                 f"bad rulestring {self.rulestring!r}; want e.g. 'B3/S23'"
             )
+        # Canonicalize (sorted, deduplicated digits) so semantically equal
+        # rules compare/hash equal — 'B3/S32' IS Conway, and equality is
+        # what gates engine reuse and checkpoint-rule guards.
+        canon = (f"B{''.join(sorted(set(m.group('b'))))}"
+                 f"/S{''.join(sorted(set(m.group('s'))))}")
+        object.__setattr__(self, "rulestring", canon)
 
     @property
     def born(self) -> frozenset:

@@ -135,6 +135,10 @@ def main() -> None:
                     help="multi-host engine: jax.distributed coordinator "
                          "address (falls back to GOL_COORDINATOR; unset = "
                          "single-host)")
+    ap.add_argument("--rule", metavar="B.../S...",
+                    default=os.environ.get("GOL_RULE") or "B3/S23",
+                    help="life-like rulestring this engine evolves "
+                         "(default Conway; falls back to GOL_RULE)")
     args = ap.parse_args()
     # Join the multi-host engine cluster BEFORE the engine snapshots
     # jax.devices() — after this, meshes span the pod (SURVEY §2d).
@@ -145,12 +149,16 @@ def main() -> None:
 
         print(f"multi-host engine: process {jax.process_index()}/"
               f"{jax.process_count()}, {len(jax.devices())} device(s)")
-    srv = EngineServer(port=args.port, host=args.host)
+    from gol_tpu.models.lifelike import LifeLikeRule
+
+    srv = EngineServer(port=args.port, host=args.host,
+                       engine=Engine(rule=LifeLikeRule(args.rule)))
     if args.resume:
         turn = srv.engine.load_checkpoint(args.resume)
         print(f"restored checkpoint {args.resume} at turn {turn}")
     print(f"gol_tpu engine serving on :{srv.port} "
-          f"({len(np.atleast_1d(srv.engine._devices))} device(s))")
+          f"({len(np.atleast_1d(srv.engine._devices))} device(s), "
+          f"rule {srv.engine._rule.rulestring})")
     srv.serve_forever()
 
 

@@ -1,0 +1,150 @@
+"""Life-like rule family exposed end-to-end: GOL_RULE/--rule drive the
+full controller -> engine -> events stack, not just the kernels. Expected
+boards come from a deliberately naive per-cell oracle written here —
+independent of every framework code path (beyond-reference capability:
+the Go kernel hardcodes Conway, `SubServer/distributor.go:179-201`)."""
+
+import queue
+
+import numpy as np
+import pytest
+
+from gol_tpu import Params, events as ev
+from gol_tpu.distributor import distributor
+from gol_tpu.engine import Engine
+from gol_tpu.models.lifelike import HIGHLIFE, SEEDS
+from gol_tpu.server import EngineServer
+
+
+def naive_lifelike(board, turns, born, survive):
+    board = board.astype(np.uint8)
+    h, w = board.shape
+    for _ in range(turns):
+        nxt = np.zeros_like(board)
+        for y in range(h):
+            for x in range(w):
+                n = sum(
+                    board[(y + dy) % h, (x + dx) % w]
+                    for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+                    if (dy, dx) != (0, 0)
+                )
+                nxt[y, x] = (
+                    1 if (n in survive if board[y, x] else n in born) else 0
+                )
+        board = nxt
+    return board
+
+
+def seed_board(n=16):
+    rng = np.random.default_rng(7)
+    return (rng.random((n, n)) < 0.35).astype(np.uint8)
+
+
+def run_stack(p, engine, images_dir, out_dir):
+    q = queue.Queue()
+    distributor(p, q, None, engine=engine,
+                images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(q)
+    final = [e for e in evs if isinstance(e, ev.FinalTurnComplete)][0]
+    board = np.zeros((p.image_height, p.image_width), dtype=np.uint8)
+    for x, y in final.alive:
+        board[y, x] = 1
+    return board, final.completed_turns
+
+
+@pytest.fixture
+def seeded_images(tmp_path):
+    from gol_tpu.io.pgm import write_pgm
+
+    d = tmp_path / "images"
+    d.mkdir()
+    write_pgm(str(d / "16x16.pgm"), seed_board() * 255)
+    return str(d)
+
+
+@pytest.mark.parametrize("rule,bs", [
+    (HIGHLIFE, ({3, 6}, {2, 3})),
+    (SEEDS, ({2}, set())),
+])
+def test_rule_through_full_stack_in_process(
+    rule, bs, seeded_images, out_dir, monkeypatch
+):
+    monkeypatch.setenv("GOL_RULE", rule.rulestring)
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    import gol_tpu.distributor as dist
+
+    monkeypatch.setattr(dist, "_default_engine", None)
+    p = Params(threads=2, image_width=16, image_height=16, turns=8)
+    got, turn = run_stack(p, None, seeded_images, out_dir)
+    want = naive_lifelike(seed_board(), 8, *bs)
+    assert turn == 8
+    np.testing.assert_array_equal(got, want)
+
+
+def test_rule_through_server(seeded_images, out_dir, monkeypatch):
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    monkeypatch.delenv("GOL_RULE", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    srv = EngineServer(port=0, host="127.0.0.1",
+                       engine=Engine(rule=HIGHLIFE))
+    srv.start_background()
+    try:
+        monkeypatch.setenv("SER", f"127.0.0.1:{srv.port}")
+        p = Params(threads=2, image_width=16, image_height=16, turns=6)
+        got, turn = run_stack(p, None, seeded_images, out_dir)
+        want = naive_lifelike(seed_board(), 6, {3, 6}, {2, 3})
+        assert turn == 6
+        np.testing.assert_array_equal(got, want)
+    finally:
+        srv.shutdown()
+
+
+def test_cli_rejects_bad_rule():
+    from gol_tpu.main import main
+
+    with pytest.raises(ValueError):
+        main(["--rule", "B9/S23", "--turns", "0", "--headless"])
+
+
+def test_resolve_rule_reads_env(monkeypatch):
+    from gol_tpu.distributor import _resolve_rule
+
+    monkeypatch.setenv("GOL_RULE", "B36/S23")
+    assert _resolve_rule() == HIGHLIFE
+    monkeypatch.delenv("GOL_RULE")
+    assert _resolve_rule().is_conway
+    assert _resolve_rule(SEEDS) == SEEDS  # explicit argument wins
+
+
+def test_rulestring_canonicalization():
+    from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+
+    assert LifeLikeRule("B3/S32") == CONWAY
+    assert LifeLikeRule("B33/S223").rulestring == "B3/S23"
+    assert hash(LifeLikeRule("B63/S32")) == hash(HIGHLIFE)
+
+
+def test_rule_change_preserves_detached_board(monkeypatch):
+    """A rule request must not silently discard an engine holding
+    detached (world, turn) state — the CONT=yes contract."""
+    import gol_tpu.distributor as dist
+
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("GOL_RULE", raising=False)
+    monkeypatch.setattr(dist, "_default_engine", None)
+    eng = dist._resolve_engine()
+    world = seed_board() * 255
+    p = Params(threads=1, image_width=16, image_height=16, turns=4)
+    eng.server_distributor(p, world)
+
+    with pytest.warns(UserWarning, match="detached board"):
+        eng2 = dist._resolve_engine(HIGHLIFE)
+    assert eng2 is eng  # state preserved, engine's own rule governs
+    _, turn = eng2.get_world()
+    assert turn == 4
+
+    # An engine with NO state is rebuilt under the requested rule.
+    monkeypatch.setattr(dist, "_default_engine", None)
+    fresh = dist._resolve_engine()
+    assert dist._resolve_engine(HIGHLIFE) is not fresh
