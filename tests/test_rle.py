@@ -1,0 +1,100 @@
+"""RLE pattern format + pattern library (beyond-reference: the Go system
+reads only its own PGM dumps)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.io.rle import RleError, parse_rle, rle_board, to_rle
+from gol_tpu.models.lifelike import HIGHLIFE
+from gol_tpu.models.patterns import (
+    GOSPER_GLIDER_GUN,
+    PATTERNS,
+    pattern_cells,
+    stamp,
+)
+from gol_tpu.models.sparse import SparseTorus
+from gol_tpu.ops.reference import run_turns_np
+
+
+def test_parse_glider():
+    cells, w, h, rule = parse_rle(PATTERNS["glider"])
+    assert (w, h) == (3, 3) and rule is None
+    assert set(cells) == {(1, 0), (2, 1), (0, 2), (1, 2), (2, 2)}
+
+
+def test_header_rule_and_order():
+    cells, w, h, rule = parse_rle("x = 2, y = 1, rule = B36/S23\n2o!\n")
+    assert rule == HIGHLIFE and set(cells) == {(0, 0), (1, 0)}
+    # the spec also permits the S…/B… order
+    _, _, _, rule2 = parse_rle("x = 1, y = 1, rule = s23/b36\no!\n")
+    assert rule2 == HIGHLIFE
+    # traditional letterless survival/birth form used by older files
+    _, _, _, rule3 = parse_rle("x = 1, y = 1, rule = 23/3\no!\n")
+    assert rule3.is_conway
+
+
+def test_bad_rules_raise_rle_error():
+    for rs in ["S23", "B3", "B3/S23/x", "B9/S23", "3"]:
+        with pytest.raises(RleError):
+            parse_rle(f"x = 1, y = 1, rule = {rs}\no!\n")
+
+
+def test_to_rle_degenerate_shapes_round_trip():
+    for shape in [(0, 3), (3, 0), (0, 0)]:
+        cells, w, h, _ = parse_rle(to_rle(np.zeros(shape, dtype=np.uint8)))
+        assert cells == [] and (h, w) == shape
+
+
+def test_multidigit_runs_and_implicit_trailing():
+    cells, w, h, _ = parse_rle("x = 30, y = 2\n24bo$12o!\n")
+    assert (24, 0) in cells
+    assert sum(1 for c in cells if c[1] == 1) == 12
+
+
+@pytest.mark.parametrize("bad", [
+    "3o!",                          # no header
+    "x = 3, y = 1\n3o",             # missing terminator
+    "x = 3, y = 1\n3z!",            # unknown tag
+    "x = 2, y = 1\n3o!",            # cell outside extent
+])
+def test_parse_errors(bad):
+    with pytest.raises(RleError):
+        parse_rle(bad)
+
+
+def test_round_trip_random_boards():
+    rng = np.random.default_rng(3)
+    for shape in [(1, 1), (5, 9), (17, 33), (40, 40)]:
+        board = (rng.random(shape) < 0.4).astype(np.uint8)
+        again = rle_board(to_rle(board))
+        np.testing.assert_array_equal(again, board)
+
+
+def test_gosper_gun_grows_and_matches_oracle():
+    board = np.zeros((128, 128), dtype=np.uint8)
+    stamp(board, "gosper-gun", at=(10, 10))
+    assert board.sum() == 36  # published gun population
+    turns = 120  # gliders stay well inside 128² (c/4 southeast)
+    want = run_turns_np(board, turns)
+    assert want.sum() > 36, "the gun must have fired"
+
+    sp = SparseTorus(2**20, pattern_cells("gosper-gun", at=(10, 10)))
+    sp.run(turns)
+    got = np.zeros_like(board)
+    for x, y in sp.alive_cells():
+        got[y, x] = 1
+    np.testing.assert_array_equal(got, want)
+
+
+def test_glider_travels_via_pattern_lib():
+    sp = SparseTorus(2**20, pattern_cells("glider", at=(500, 500)))
+    sp.run(400)
+    want = {(x + 100, y + 100)
+            for x, y in pattern_cells("glider", at=(500, 500))}
+    assert set(sp.alive_cells()) == want
+
+
+def test_stamp_wraps_on_torus():
+    board = np.zeros((10, 10), dtype=np.uint8)
+    stamp(board, "blinker", at=(9, 9), value=255)
+    assert board[9, 9] == board[9, 0] == board[9, 1] == 255
