@@ -133,11 +133,20 @@ def distributor(
 ) -> None:
     images_dir = images_dir or os.environ.get("GOL_IMAGES", "images")
     out_dir = out_dir or os.environ.get("GOL_OUT", "out")
-    engine = engine if engine is not None else _resolve_engine(rule)
 
     width, height = p.image_width, p.image_height
     done = threading.Event()
     kp_state = {"k": False}
+
+    # Engine resolution can fail (backend init, bad GOL_RULE, …) — it
+    # must happen under the finally that delivers CLOSE, or every
+    # consumer of the events queue hangs forever on a failed startup.
+    try:
+        engine = engine if engine is not None else _resolve_engine(rule)
+    except BaseException:
+        done.set()
+        events_q.put(ev.CLOSE)
+        raise
 
     # Attach: discard control flags left by a previous controller session
     # BEFORE this session's keypress thread starts posting its own.

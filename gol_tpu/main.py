@@ -41,7 +41,41 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="life-like rulestring for the in-process engine "
                          "(e.g. B36/S23 = HighLife; default Conway). With "
                          "SER set, the remote engine's own rule governs.")
+    ap.add_argument("--rle", metavar="NAME|FILE", default="",
+                    help="seed the board from an RLE pattern instead of "
+                         "images/WxH.pgm: a library name (glider, lwss, "
+                         "rpentomino, gosper-gun, blinker) or a .rle file, "
+                         "stamped centred on an empty WxH torus")
     return ap.parse_args(argv)
+
+
+def _stage_rle_board(name_or_path: str, width: int, height: int):
+    """Stamp an RLE pattern (library name or file path) centred on an
+    empty width x height board and write it as `WxH.pgm` in a fresh temp
+    images dir. Returns (images_dir, rle_declared_rule_or_None)."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from gol_tpu.io.pgm import input_path, write_pgm
+    from gol_tpu.io.rle import parse_rle, read_rle
+    from gol_tpu.models.patterns import PATTERNS
+
+    if name_or_path in PATTERNS:
+        cells, pw, ph, rle_rule = parse_rle(PATTERNS[name_or_path])
+    else:
+        cells, pw, ph, rle_rule = read_rle(name_or_path)
+    if pw > width or ph > height:
+        raise ValueError(
+            f"pattern extent {pw}x{ph} exceeds board {width}x{height}")
+    board = np.zeros((height, width), dtype=np.uint8)
+    ox, oy = (width - pw) // 2, (height - ph) // 2
+    for x, y in cells:
+        board[oy + y, ox + x] = 255
+    d = tempfile.mkdtemp(prefix="gol_rle_")
+    write_pgm(input_path(width, height, d), board)
+    return d, rle_rule
 
 
 def main(argv=None) -> int:
@@ -63,9 +97,20 @@ def main(argv=None) -> int:
         image_height=args.height,
         turns=args.turns,
     )
+    images_dir = None
+    if args.rle:
+        # Materialise the pattern as the WxH.pgm the distributor expects
+        # (in a temp images dir) — the PGM board-source contract stays the
+        # single entry path. An RLE-declared rule applies unless --rule
+        # overrode it.
+        images_dir, rle_rule = _stage_rle_board(
+            args.rle, args.width, args.height)
+        if rule is None:
+            rule = rle_rule
     events_q: "queue.Queue" = queue.Queue(maxsize=10000)
     key_presses: "queue.Queue" = queue.Queue(maxsize=10)
-    run(p, events_q, key_presses, live_view=args.live, rule=rule)
+    run(p, events_q, key_presses, live_view=args.live, rule=rule,
+        images_dir=images_dir)
     view_start(p, events_q, key_presses, headless=args.headless)
     return 0
 

@@ -390,6 +390,19 @@ def test_flapping_link_gives_up_within_budget(images_dir, out_dir,
     assert lost - back in (0, 1) and lost <= 60
 
 
+def test_failed_engine_resolution_still_closes_events(monkeypatch):
+    """A startup failure before the engine exists (e.g. malformed
+    GOL_RULE) must still deliver CLOSE — consumers blocked on the events
+    queue would otherwise hang forever."""
+    monkeypatch.setenv("GOL_RULE", "not-a-rule")
+    monkeypatch.delenv("SER", raising=False)
+    q = queue.Queue()
+    p = Params(threads=1, image_width=16, image_height=16, turns=1)
+    with pytest.raises(ValueError):
+        distributor(p, q, None)
+    assert q.get(timeout=5) is ev.CLOSE
+
+
 def test_reconnect_disabled_propagates(images_dir, out_dir, monkeypatch):
     monkeypatch.setenv("GOL_RECONNECT", "0")
     monkeypatch.delenv("SER", raising=False)
