@@ -17,11 +17,12 @@ SIZES_TURNS = [
     (64, 0), (64, 1), (64, 100),
     (512, 0), (512, 1), (512, 100),
 ]
-# Full shard-request sweep 1..8, the analog of the reference's threads
-# 1..16 sweep (`Local/gol_test.go:25`) at this mesh's device count.
-# Non-divisors (3, 5, 6, 7 against power-of-two heights) push the
-# resolve_shard_count divisor fallback through the whole gol.run stack.
-SHARDS = [1, 2, 3, 4, 5, 6, 7, 8]
+# Full shard-request sweep, the analog of the reference's threads 1..16
+# sweep (`Local/gol_test.go:25`). Non-divisors (3, 5, 6, 7 against
+# power-of-two heights) push the resolve_shard_count divisor fallback
+# through the whole gol.run stack; 12 and 16 exceed the 8-device mesh and
+# exercise the request-clamped-to-device-count path end to end.
+SHARDS = [1, 2, 3, 4, 5, 6, 7, 8, 12, 16]
 
 
 def run_and_get_final(p, images_dir, out_dir, sub_count, monkeypatch):
