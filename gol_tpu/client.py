@@ -157,6 +157,10 @@ class RemoteEngine:
         resp, _ = self._call({"method": "Ping"}, timeout=self._timeout)
         return int(resp["turn"])
 
+    def stats(self) -> dict:
+        resp, _ = self._call({"method": "Stats"}, timeout=self._timeout)
+        return dict(resp["stats"])
+
     def abort_run(self) -> bool:
         """Stop the engine's current run IF it is this controller's own
         (token match); returns whether an abort was delivered."""

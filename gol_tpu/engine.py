@@ -146,6 +146,9 @@ class Engine:
         # observed for a full chunk); engine-lifetime, it only sharpens.
         self._fixed_cost_est = float("inf")
         self._max_chunk = MAX_CHUNK
+        # Rolling throughput telemetry for the Stats RPC.
+        self._last_chunk = 0
+        self._turns_per_s = 0.0
 
     # ------------------------------------------------------------------ RPC
 
@@ -241,6 +244,10 @@ class Engine:
                     wait(cells)
                     elapsed = time.monotonic() - t0
                     chunk = self._adapt_chunk(chunk, k, elapsed)
+                    with self._state_lock:
+                        self._last_chunk = k
+                        if elapsed > 0:
+                            self._turns_per_s = k / elapsed
                 chunks_done += 1
                 with self._state_lock:
                     self._cells = cells
@@ -328,6 +335,30 @@ class Engine:
         self._check_alive()
         with self._state_lock:
             return self._turn
+
+    def stats(self) -> dict:
+        """Engine telemetry snapshot for operators (no device work):
+        completed turn, run state, board geometry, current compiled chunk
+        size, measured turns/s of the last full chunk, rule, devices.
+        Beyond-reference observability (SURVEY §5: the Go system's only
+        metric is the alive-count poll)."""
+        self._check_alive()
+        with self._state_lock:
+            cells = self._cells
+            shape = None
+            if cells is not None:
+                h, w = cells.shape[-2], cells.shape[-1]
+                shape = [h, w * 32] if self._packed else [h, w]
+            return {
+                "turn": self._turn,
+                "running": self._running,
+                "board": shape,
+                "packed": self._packed,
+                "chunk": self._last_chunk,
+                "turns_per_s": round(self._turns_per_s, 1),
+                "rule": self._rule.rulestring,
+                "devices": len(self._devices),
+            }
 
     # -------------------------------------------------------- checkpointing
 

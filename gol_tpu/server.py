@@ -94,6 +94,8 @@ class EngineServer:
                 send_msg(conn, {"ok": True, "aborted": aborted})
             elif method == "Ping":
                 send_msg(conn, {"ok": True, "turn": self.engine.ping()})
+            elif method == "Stats":
+                send_msg(conn, {"ok": True, "stats": self.engine.stats()})
             elif method == "Alivecount":
                 alive, turn = self.engine.alive_count()
                 send_msg(conn, {"ok": True, "alive": alive, "turn": turn})
@@ -156,6 +158,31 @@ def main() -> None:
     if args.resume:
         turn = srv.engine.load_checkpoint(args.resume)
         print(f"restored checkpoint {args.resume} at turn {turn}")
+
+    # Graceful shutdown: with checkpointing configured (GOL_CKPT), a
+    # SIGTERM writes one final checkpoint before exiting, so an orderly
+    # stop (systemd, k8s preStop, operator) loses zero turns — a
+    # replacement server --resume picks up exactly where this one ended.
+    import signal
+    from gol_tpu.engine import CKPT_ENV
+
+    def _on_term(signo, frame):
+        ckpt_dir = os.environ.get(CKPT_ENV, "")
+        if ckpt_dir:
+            try:
+                world, turn = srv.engine.get_world()
+                os.makedirs(ckpt_dir, exist_ok=True)
+                path = os.path.join(
+                    ckpt_dir,
+                    f"{world.shape[1]}x{world.shape[0]}.npz")
+                srv.engine.save_checkpoint(path)
+                print(f"SIGTERM: checkpointed turn {turn} to {path}",
+                      flush=True)
+            except Exception as e:
+                print(f"SIGTERM: checkpoint failed: {e}", flush=True)
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
     print(f"gol_tpu engine serving on :{srv.port} "
           f"({len(np.atleast_1d(srv.engine._devices))} device(s), "
           f"rule {srv.engine._rule.rulestring})")

@@ -98,6 +98,69 @@ def test_heartbeat_unblocks_hung_connection(monkeypatch):
             c.close()
 
 
+def test_stats_rpc(server):
+    eng = RemoteEngine(f"127.0.0.1:{server.port}")
+    s0 = eng.stats()
+    assert s0["turn"] == 0 and s0["board"] is None and not s0["running"]
+    world = np.zeros((16, 32), dtype=np.uint8)
+    world[4:7, 5] = 255
+    p = Params(threads=1, image_width=32, image_height=16, turns=64)
+    eng.server_distributor(p, world)
+    s = eng.stats()
+    assert s["turn"] == 64 and s["board"] == [16, 32]
+    assert s["rule"] == "B3/S23" and s["devices"] >= 1
+    assert s["chunk"] >= 1 and s["turns_per_s"] > 0
+
+
+def test_sigterm_checkpoints_and_resumes(tmp_path, monkeypatch):
+    """Orderly shutdown loses zero turns: SIGTERM writes a final
+    checkpoint (GOL_CKPT) and a replacement server --resume serves the
+    exact (world, turn) evolution."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    env = {
+        "GOL_CKPT": ckpt_dir,
+        "GOL_CKPT_EVERY": "9999",  # periodic off: only SIGTERM writes
+        "GOL_MAX_CHUNK": "16",
+    }
+    proc1 = _spawn_server(0, tmp_path, extra_env=env)
+    proc2 = None
+    try:
+        port = _wait_port(proc1)
+        assert port
+        eng = RemoteEngine(f"127.0.0.1:{port}")
+        world = np.zeros((64, 64), dtype=np.uint8)
+        world[30:33, 31] = 255
+        world[10, 10:13] = 255
+        p = Params(threads=2, image_width=64, image_height=64,
+                   turns=10**8)
+        threading.Thread(
+            target=lambda: eng.server_distributor(p, world),
+            daemon=True).start()
+        deadline = time.monotonic() + 60
+        while eng.ping() == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        proc1.send_signal(signal.SIGTERM)
+        proc1.wait(30)
+        ckpt = os.path.join(ckpt_dir, "64x64.npz")
+        assert os.path.exists(ckpt), "SIGTERM did not checkpoint"
+
+        proc2 = _spawn_server(0, tmp_path, extra_env=env, resume=ckpt)
+        port2 = _wait_port(proc2)
+        assert port2
+        eng2 = RemoteEngine(f"127.0.0.1:{port2}")
+        restored, turn = eng2.get_world()
+        assert turn >= 1
+        want = run_turns_np((world != 0).astype(np.uint8), turn)
+        np.testing.assert_array_equal(
+            (restored != 0).astype(np.uint8), want)
+    finally:
+        for proc in (proc1, proc2):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+
+
 class FlakyEngine:
     """Wraps a real Engine. The first run call advances `die_after` turns
     and then raises ConnectionError (the crash); every later call passes
