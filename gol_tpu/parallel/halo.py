@@ -176,14 +176,21 @@ def _packed_deep_macro(
     inner: str,
 ):
     """One macro-step: exchange T-row halos, advance the window T turns
-    (`inner`: 'pallas' | 'pallas-interpret' | 'jnp'), keep the exact
-    middle."""
+    (`inner`: 'banded[-interpret]' | 'pallas[-interpret]' | 'jnp'), keep
+    the exact middle."""
     from gol_tpu.ops.bitpack import packed_run_turns
-    from gol_tpu.ops.pallas_stencil import pallas_packed_run_turns
+    from gol_tpu.ops.pallas_stencil import (
+        banded_packed_run_turns,
+        pallas_packed_run_turns,
+    )
 
     top, bot = _exchange_row_halos(local, n_shards, depth=T)
     window = jnp.concatenate([top, local, bot], axis=0)
-    if inner == "pallas":
+    if inner == "banded":
+        window = banded_packed_run_turns(window, T, rule)
+    elif inner == "banded-interpret":
+        window = banded_packed_run_turns(window, T, rule, interpret=True)
+    elif inner == "pallas":
         window = pallas_packed_run_turns(window, T, rule)
     elif inner == "pallas-interpret":
         window = pallas_packed_run_turns(window, T, rule, interpret=True)
@@ -222,14 +229,20 @@ def _make_compiled_deep_run(
 
 
 def inner_kind(mesh: Mesh, window_shape) -> str:
-    """Per-shard engine for a deep-halo window: the VMEM pallas kernel on
-    TPU when the window fits, else the jnp packed scan. Shared by the 1-D
-    and 2-D deep-halo paths."""
-    from gol_tpu.ops.pallas_stencil import fits_in_vmem
+    """Per-shard engine for a deep-halo window — the same preference
+    order as the single-device dispatch (`packed_run_kind`): the banded
+    HBM kernel when the window's word axis is lane-aligned (the fastest
+    tier, and the only one that scales to per-shard windows beyond VMEM),
+    else the whole-window VMEM kernel when it fits, else the jnp packed
+    scan. Shared by the 1-D and 2-D deep-halo paths."""
+    from gol_tpu.ops.pallas_stencil import banded_supported, fits_in_vmem
 
     platform = mesh.devices.flat[0].platform
-    if platform == "tpu" and fits_in_vmem(window_shape):
-        return "pallas"
+    if platform == "tpu":
+        if banded_supported(window_shape):
+            return "banded"
+        if fits_in_vmem(window_shape):
+            return "pallas"
     return "jnp"
 
 

@@ -72,7 +72,10 @@ def _macro_2d(
     inner: str,
 ):
     """One T-turn macro-step of one (rows, wcols) shard."""
-    from gol_tpu.ops.pallas_stencil import pallas_packed_run_turns
+    from gol_tpu.ops.pallas_stencil import (
+        banded_packed_run_turns,
+        pallas_packed_run_turns,
+    )
     from gol_tpu.parallel.halo import exchange_halos
 
     # Vertical: T rows from the ring neighbours above/below.
@@ -82,7 +85,14 @@ def _macro_2d(
     # taken from the row-extended window so corners are included.
     west, east = exchange_halos(tall, n_cols, COLS_AXIS, depth=1, axis=1)
     window = jnp.concatenate([west, tall, east], axis=1)
-    if inner == "pallas":
+    # (The +2-word horizontal halo makes the window's word axis almost
+    # never 128-lane aligned, so 'banded' is rare here — but inner_kind
+    # is shared with the 1-D path, so honour every kind it can emit.)
+    if inner == "banded":
+        window = banded_packed_run_turns(window, T, rule)
+    elif inner == "banded-interpret":
+        window = banded_packed_run_turns(window, T, rule, interpret=True)
+    elif inner == "pallas":
         window = pallas_packed_run_turns(window, T, rule)
     elif inner == "pallas-interpret":
         window = pallas_packed_run_turns(window, T, rule, interpret=True)

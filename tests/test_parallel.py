@@ -153,3 +153,31 @@ def test_deep_halo_pallas_interpret_inner():
     got = np.asarray(unpack(run(sharded, 3)))  # 3 macros x 4 turns
     want = np.asarray(run_turns(board, 12))
     np.testing.assert_array_equal(got, want)
+
+
+def test_deep_halo_banded_interpret_inner():
+    # The banded HBM kernel as the per-shard inner engine — what the TPU
+    # multi-chip path composes for big lane-aligned per-shard windows.
+    # Width 4096 (wp=128) with 128-row shards: window 128+2*16 = 160 rows.
+    board = random_board(512, 4096, seed=29)
+    mesh = make_mesh(4)
+    sharded = shard_board(pack(board), mesh)
+    run = _make_compiled_deep_run(mesh, CONWAY, 16, "banded-interpret")
+    got = np.asarray(unpack(run(sharded, 2)))  # 2 macros x 16 turns
+    want = np.asarray(run_turns(board, 32))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_inner_kind_prefers_banded_for_aligned_windows():
+    from gol_tpu.parallel.halo import inner_kind
+
+    class FakeDev:
+        platform = "tpu"
+
+    class FakeMesh:
+        class devices:
+            flat = [FakeDev()]
+
+    assert inner_kind(FakeMesh, (160, 128)) == "banded"
+    assert inner_kind(FakeMesh, (160, 16)) == "pallas"   # 512-wide board
+    assert inner_kind(FakeMesh, (70000, 16)) == "jnp"    # beyond VMEM
