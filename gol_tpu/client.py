@@ -26,7 +26,7 @@ from typing import Sequence, Tuple
 
 import numpy as np
 
-from gol_tpu.engine import EngineKilled
+from gol_tpu.engine import EngineBusy, EngineKilled
 from gol_tpu.params import Params
 from gol_tpu.utils.envcfg import env_float, env_int
 from gol_tpu.wire import recv_msg, send_msg
@@ -42,6 +42,8 @@ def _check_resp(resp: dict):
         err = resp.get("error", "unknown engine error")
         if err.startswith("killed:"):
             raise EngineKilled(err)
+        if err.startswith("busy:"):
+            raise EngineBusy(err)
         raise RuntimeError(f"engine error: {err}")
     return resp
 

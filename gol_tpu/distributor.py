@@ -23,6 +23,7 @@ import numpy as np
 from gol_tpu import events as ev
 from gol_tpu.engine import (
     Engine,
+    EngineBusy,
     EngineKilled,
     FLAG_KILL,
     FLAG_PAUSE,
@@ -305,15 +306,14 @@ def distributor(
                 except EngineKilled:
                     final_world, final_turn = world, start_turn
                     break
-            except RuntimeError as e:
-                # "already running": after a TRANSIENT partition the server
-                # never saw the dead socket, so this run's pre-partition
-                # orphan still occupies the engine. abort_run is
-                # token-scoped — it stops OUR orphan and is a no-op on a
-                # foreign controller's run, which then keeps failing the
-                # resubmit until the episode deadline re-raises here.
+            except EngineBusy:
+                # After a TRANSIENT partition the server never saw the
+                # dead socket, so this run's pre-partition orphan still
+                # occupies the engine. abort_run is token-scoped — it
+                # stops OUR orphan and is a no-op on a foreign
+                # controller's run, which then keeps failing the resubmit
+                # until the episode deadline re-raises here.
                 if not (recovery_deadline is not None
-                        and "already running" in str(e)
                         and hasattr(engine, "abort_run")):
                     raise
                 if time.monotonic() >= recovery_deadline:

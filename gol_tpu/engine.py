@@ -86,6 +86,13 @@ class EngineKilled(RuntimeError):
     reference worker's os.Exit(0) (`SubServer/distributor.go:42-45`)."""
 
 
+class EngineBusy(RuntimeError):
+    """A run was submitted while the engine is already running a board.
+    Typed (and wire-mapped with a 'busy:' prefix) so the controller's
+    partition-recovery logic can recognise its own orphaned run without
+    matching on message text."""
+
+
 def _next_chunk(chunk: int, remaining: int) -> int:
     """Largest power of two ≤ min(chunk, remaining). Keeping every compiled
     loop length a power of two bounds the set of distinct XLA programs per
@@ -173,7 +180,7 @@ class Engine:
         """
         self._check_alive()
         if self._running:
-            raise RuntimeError("engine already running a board")
+            raise EngineBusy("engine already running a board")
 
         height, width = world.shape
         packed, run = select_representation(width)
@@ -199,7 +206,7 @@ class Engine:
                 pack(cells01) if packed else cells01, mesh)
         with self._state_lock:
             if self._running:  # re-check under the lock (TOCTOU)
-                raise RuntimeError("engine already running a board")
+                raise EngineBusy("engine already running a board")
             self._cells = cells
             self._packed = packed
             self._turn = start_turn

@@ -20,7 +20,7 @@ from typing import Optional
 
 import numpy as np
 
-from gol_tpu.engine import Engine, EngineKilled
+from gol_tpu.engine import Engine, EngineBusy, EngineKilled
 from gol_tpu.params import Params
 from gol_tpu.wire import recv_msg, send_msg
 
@@ -121,6 +121,8 @@ class EngineServer:
                                 "error": f"unknown method {method!r}"})
         except EngineKilled as e:
             send_msg(conn, {"ok": False, "error": f"killed: {e}"})
+        except EngineBusy as e:
+            send_msg(conn, {"ok": False, "error": f"busy: {e}"})
         except Exception as e:  # surface engine errors to the client
             send_msg(conn, {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
@@ -170,14 +172,16 @@ def main() -> None:
         ckpt_dir = os.environ.get(CKPT_ENV, "")
         if ckpt_dir:
             try:
-                world, turn = srv.engine.get_world()
-                os.makedirs(ckpt_dir, exist_ok=True)
-                path = os.path.join(
-                    ckpt_dir,
-                    f"{world.shape[1]}x{world.shape[0]}.npz")
-                srv.engine.save_checkpoint(path)
-                print(f"SIGTERM: checkpointed turn {turn} to {path}",
-                      flush=True)
+                # stats() gives (board geometry, turn) without the full
+                # board transfer get_world() would cost.
+                s = srv.engine.stats()
+                if s["board"] is not None:
+                    h, w = s["board"]
+                    os.makedirs(ckpt_dir, exist_ok=True)
+                    path = os.path.join(ckpt_dir, f"{w}x{h}.npz")
+                    srv.engine.save_checkpoint(path)
+                    print(f"SIGTERM: checkpointed turn {s['turn']} to "
+                          f"{path}", flush=True)
             except Exception as e:
                 print(f"SIGTERM: checkpoint failed: {e}", flush=True)
         os._exit(0)
