@@ -54,8 +54,17 @@ def test_alive_telemetry(images_dir, check_dir, out_dir, monkeypatch):
                 f"turn {e.completed_turns}: got {e.cells_count}, "
                 f"want {golden[e.completed_turns]}"
             )
-            verified += 1
-    assert verified >= 1, "no tick landed within the golden CSV range"
+        else:
+            # Beyond the CSV the seeded board's ash is period-2
+            # (stabilised before turn 10000; values computed by the
+            # native u64 oracle) — the analog of the reference board's
+            # 5565/5567 oscillation check (`Local/count_test.go:43-49`).
+            want = 7527 if e.completed_turns % 2 == 0 else 7525
+            assert e.cells_count == want, (
+                f"turn {e.completed_turns}: got {e.cells_count}, "
+                f"want oscillating {want}")
+        verified += 1
+    assert verified >= 1, "no tick verified"
     # quit the unbounded run (`q` keypress, flag 2) and drain to CLOSE.
     keys.put("q")
     while True:
