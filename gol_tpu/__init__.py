@@ -18,29 +18,44 @@ Public surface (mirrors reference `Local/gol/gol.go:4-12`):
 
 import os as _os
 
-if _os.environ.get("GOL_COMPILE_CACHE"):
-    # Opt-in persistent XLA compilation cache: kills the engine's cold
-    # chunk-ramp compile cost (~17 power-of-two loop lengths) across
-    # process restarts. Must be configured before the first compile.
-    # Each option is guarded: on a JAX version lacking one of these
-    # config names, degrade to whatever subset exists (worst case no
-    # persistent cache) rather than making `import gol_tpu` itself raise.
-    import warnings as _warnings
 
-    import jax as _jax
+def enable_compile_cache(cache_dir: str) -> None:
+    """Point XLA's persistent compilation cache at `cache_dir`: kills the
+    engine's cold chunk-ramp compile cost (~17 power-of-two loop lengths)
+    across process restarts. Must run before the first compile. Each
+    option is guarded: on a JAX version lacking one of these config
+    names, degrade to whatever subset exists (worst case no persistent
+    cache) rather than raising."""
+    import warnings
 
-    for _name, _value in (
-        ("jax_compilation_cache_dir", _os.environ["GOL_COMPILE_CACHE"]),
+    import jax
+
+    for name, value in (
+        ("jax_compilation_cache_dir", cache_dir),
         ("jax_persistent_cache_min_entry_size_bytes", -1),
         ("jax_persistent_cache_min_compile_time_secs", 0),
     ):
         try:
-            _jax.config.update(_name, _value)
-        except (AttributeError, KeyError, ValueError) as _e:
-            _warnings.warn(
-                f"GOL_COMPILE_CACHE: jax.config has no {_name!r} "
-                f"({_e}); persistent compile cache may be degraded")
-    del _warnings, _name, _value
+            jax.config.update(name, value)
+        except (AttributeError, KeyError, ValueError) as e:
+            warnings.warn(
+                f"compile cache: jax.config has no {name!r} "
+                f"({e}); persistent compile cache may be degraded")
+
+
+def default_compile_cache_dir() -> str:
+    return _os.path.join(
+        _os.environ.get(
+            "XDG_CACHE_HOME",
+            _os.path.join(_os.path.expanduser("~"), ".cache")),
+        "gol_tpu", "xla")
+
+
+if _os.environ.get("GOL_COMPILE_CACHE"):
+    # Opt-in at import time via env; the CLI entry points additionally
+    # default-enable the cache (see main.py / server.py) — set
+    # GOL_COMPILE_CACHE="" to disable it there.
+    enable_compile_cache(_os.environ["GOL_COMPILE_CACHE"])
 
 from gol_tpu.params import Params
 from gol_tpu.events import (
@@ -56,7 +71,7 @@ from gol_tpu.events import (
 )
 from gol_tpu.gol import run
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "Params",

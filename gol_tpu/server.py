@@ -144,6 +144,18 @@ def main() -> None:
                     help="life-like rulestring this engine evolves "
                          "(default Conway; falls back to GOL_RULE)")
     args = ap.parse_args()
+    if "GOL_COMPILE_CACHE" not in os.environ:
+        # Server restarts (checkpoint resume, failover) should not repay
+        # the chunk-ramp compiles; GOL_COMPILE_CACHE="" disables. CPU is
+        # excluded — XLA:CPU's AOT cache embeds exact machine features
+        # and reloads can SIGILL/wedge.
+        import jax
+
+        if jax.default_backend() != "cpu":
+            import gol_tpu
+
+            gol_tpu.enable_compile_cache(
+                gol_tpu.default_compile_cache_dir())
     # Join the multi-host engine cluster BEFORE the engine snapshots
     # jax.devices() — after this, meshes span the pod (SURVEY §2d).
     from gol_tpu.parallel import multihost
