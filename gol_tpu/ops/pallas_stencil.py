@@ -231,8 +231,12 @@ def _banded_pass(
             pltpu.VMEM((band + 2 * halo_t, wp), jnp.uint32),
             pltpu.SemaphoreType.DMA((3,)),
         ],
+        # Bands are independent (they all read the unchanged input), so
+        # the grid is parallel — Mosaic splits it across TensorCores on
+        # multi-core chips (free on the 1-core v5e this was tuned on).
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=VMEM_LIMIT_BYTES
+            vmem_limit_bytes=VMEM_LIMIT_BYTES,
+            dimension_semantics=("parallel",),
         ),
         interpret=interpret,
     )(packed)
