@@ -82,6 +82,8 @@ def read_pgm(path: str) -> np.ndarray:
     htok, pos = _read_token(buf, pos)
     mtok, pos = _read_token(buf, pos)
     width, height, maxval = int(wtok), int(htok), int(mtok)
+    if width <= 0 or height <= 0:
+        raise ValueError(f"{path}: non-positive dims {width}x{height}")
     if maxval != MAXVAL:
         raise ValueError(f"{path}: maxval must be {MAXVAL}, got {maxval}")
     pos += 1  # exactly one whitespace byte separates header from payload

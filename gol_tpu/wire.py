@@ -60,10 +60,21 @@ def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
     (n,) = _LEN.unpack(_recv_exact(sock, 4))
     if n > MAX_HEADER:
         raise ConnectionError(f"header too large: {n}")
-    header = json.loads(_recv_exact(sock, n))
+    raw = _recv_exact(sock, n)
+    try:
+        header = json.loads(raw)
+    except ValueError as e:  # bad UTF-8 or bad JSON — peer is garbage
+        raise ConnectionError(f"malformed header: {e}") from e
+    if not isinstance(header, dict):
+        raise ConnectionError(
+            f"malformed header: expected object, got {type(header).__name__}")
     world = None
     if "world" in header and header["world"] is not None:
-        h, w = int(header["world"]["h"]), int(header["world"]["w"])
+        try:
+            h = int(header["world"]["h"])
+            w = int(header["world"]["w"])
+        except (TypeError, KeyError, ValueError) as e:
+            raise ConnectionError(f"malformed world dims: {e}") from e
         if h <= 0 or w <= 0 or h * w > MAX_BOARD_CELLS:
             raise ConnectionError(f"board dims out of bounds: {h}x{w}")
         world = np.frombuffer(
