@@ -328,6 +328,7 @@ def distributor(
                 time.sleep(0.3)
 
             # -- reattach: refresh state, then resubmit ------------------
+            contacted = True
             try:
                 # Engine is back with authoritative state (it survived, or
                 # was restarted from a checkpoint): resume from it.
@@ -335,13 +336,18 @@ def distributor(
             except EngineKilled:
                 final_world, final_turn = world, start_turn
                 break
-            except (RuntimeError, ConnectionError, OSError):
-                # Engine restarted empty (or flapped again between ping
-                # and snapshot): resubmit the last-known board from the
-                # last-known turn — deterministic re-evolution.
+            except RuntimeError:
+                # Engine answered but restarted empty: resubmit the
+                # last-known board from the last-known turn —
+                # deterministic re-evolution.
                 pass
+            except (ConnectionError, OSError):
+                # Flapped again between ping and snapshot: contact is NOT
+                # restored — no Reattached event; the resubmit below will
+                # fail back into the recovery branch.
+                contacted = False
             turns_left = max(p.turns - start_turn, 0)
-            if lost_pending:
+            if lost_pending and contacted:
                 events_q.put(ev.EngineReattached(start_turn))
                 lost_pending = False
 

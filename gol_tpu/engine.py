@@ -325,10 +325,14 @@ class Engine:
         reclaims the engine). No reference counterpart: the Go broker has
         no way to be reclaimed by a controller that lost it. No-op (False)
         when idle or when the run belongs to another controller; on abort
-        the state is preserved at the stop point exactly like FLAG_QUIT."""
+        the state is preserved at the stop point exactly like FLAG_QUIT.
+        A tokenless run cannot be aborted at all (None never matches) —
+        otherwise any peer sending AbortRun with no token could stop a
+        legacy client's run."""
         self._check_alive()
         with self._state_lock:
-            if self._running and self._run_token == token:
+            if (token is not None and self._running
+                    and self._run_token == token):
                 self._abort.set()
                 return True
             return False
@@ -370,14 +374,24 @@ class Engine:
     # -------------------------------------------------------- checkpointing
 
     def save_checkpoint(self, path: str) -> None:
-        """Atomically write (world, turn, rulestring) as a compressed .npz."""
+        """Atomically write (world, turn, rulestring) as a compressed .npz.
+
+        The temp name is per-writer: the SIGTERM handler (main thread) can
+        race the run thread's periodic save on the same target, and a
+        shared '.tmp' would let the two writers interleave and publish a
+        torn file; with unique temps each os.replace publishes a complete
+        checkpoint (last one wins)."""
         world, turn = self._snapshot()
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez_compressed(
-                f, world=world, turn=turn,
-                rulestring=self._rule.rulestring)
-        os.replace(tmp, path)
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f, world=world, turn=turn,
+                    rulestring=self._rule.rulestring)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
     def load_checkpoint(self, path: str) -> int:
         """Restore (world, turn) from a checkpoint; returns the turn.

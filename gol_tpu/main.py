@@ -120,6 +120,13 @@ def main(argv=None) -> int:
             args.rle, args.width, args.height)
         if rule is None:
             rule = rle_rule
+            if rle_rule is not None and os.environ.get("SER"):
+                import warnings
+
+                warnings.warn(
+                    f"--rle declares rule {rle_rule.rulestring}, but with "
+                    "SER set the REMOTE engine's own rule governs the "
+                    "run — start the server with --rule to match")
     events_q: "queue.Queue" = queue.Queue(maxsize=10000)
     key_presses: "queue.Queue" = queue.Queue(maxsize=10)
     run(p, events_q, key_presses, live_view=args.live, rule=rule,

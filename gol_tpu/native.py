@@ -47,6 +47,13 @@ def ensure_built(quiet: bool = True) -> bool:
             capture_output=quiet,
             timeout=120,
         )
+        # Equal source/.so mtimes count as stale here (same-second git
+        # checkouts) but make treats them as up to date and won't rebuild
+        # — bump the .so mtime after a successful pass so the NEXT import
+        # doesn't fork make again forever.
+        if _LIB_PATH.exists() and _so_is_stale() \
+                and not os.environ.get("GOL_NATIVE_FRESHEN"):
+            os.utime(_LIB_PATH)
     except (OSError, subprocess.SubprocessError):
         pass  # no toolchain: fall through — a previous build still counts
     return _LIB_PATH.exists()

@@ -144,6 +144,17 @@ def main() -> None:
                     help="life-like rulestring this engine evolves "
                          "(default Conway; falls back to GOL_RULE)")
     args = ap.parse_args()
+    # Join the multi-host engine cluster FIRST: jax.distributed must
+    # initialize before ANYTHING touches the XLA backend (including the
+    # compile-cache block below, whose jax.default_backend() call would
+    # otherwise poison it). After this, meshes span the pod (SURVEY §2d).
+    from gol_tpu.parallel import multihost
+
+    if multihost.initialize(args.coordinator or None):
+        import jax
+
+        print(f"multi-host engine: process {jax.process_index()}/"
+              f"{jax.process_count()}, {len(jax.devices())} device(s)")
     if "GOL_COMPILE_CACHE" not in os.environ:
         # Server restarts (checkpoint resume, failover) should not repay
         # the chunk-ramp compiles; GOL_COMPILE_CACHE="" disables. CPU is
@@ -156,15 +167,6 @@ def main() -> None:
 
             gol_tpu.enable_compile_cache(
                 gol_tpu.default_compile_cache_dir())
-    # Join the multi-host engine cluster BEFORE the engine snapshots
-    # jax.devices() — after this, meshes span the pod (SURVEY §2d).
-    from gol_tpu.parallel import multihost
-
-    if multihost.initialize(args.coordinator or None):
-        import jax
-
-        print(f"multi-host engine: process {jax.process_index()}/"
-              f"{jax.process_count()}, {len(jax.devices())} device(s)")
     from gol_tpu.models.lifelike import LifeLikeRule
 
     srv = EngineServer(port=args.port, host=args.host,

@@ -28,7 +28,9 @@ MAX_HEADER = 1 << 20
 # header must not be able to trigger an arbitrary-size allocation. The
 # reference trusts gob inside a VPC; a hand-rolled TCP plane bounds its
 # inputs. Hosts serving larger boards raise it via GOL_MAX_BOARD_CELLS.
-MAX_BOARD_CELLS = int(os.environ.get("GOL_MAX_BOARD_CELLS", str(1 << 32)))
+from gol_tpu.utils.envcfg import env_int
+
+MAX_BOARD_CELLS = env_int("GOL_MAX_BOARD_CELLS", 1 << 32)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -271,6 +271,8 @@ class PartitionEngine:
         self.partitioned = False
         self.aborts = 0
         self.flags_seen = []
+        # Like RemoteEngine: every recoverable client tokens its runs.
+        self.token = "partition-test-token"
 
     def server_distributor(self, params, world, sub_workers=(),
                            start_turn=0):
@@ -279,13 +281,14 @@ class PartitionEngine:
             threading.Thread(
                 target=self.inner.server_distributor,
                 args=(params, world, sub_workers),
-                kwargs=dict(start_turn=start_turn),
+                kwargs=dict(start_turn=start_turn, token=self.token),
                 daemon=True,
             ).start()
             time.sleep(0.5)  # let the orphan get going
             raise ConnectionError("simulated partition")
         return self.inner.server_distributor(
-            params, world, sub_workers, start_turn=start_turn)
+            params, world, sub_workers, start_turn=start_turn,
+            token=self.token)
 
     def cf_put(self, flag):
         self.flags_seen.append(flag)
@@ -293,7 +296,7 @@ class PartitionEngine:
 
     def abort_run(self):
         self.aborts += 1
-        return self.inner.abort_run()
+        return self.inner.abort_run(self.token)
 
     def get_world(self):
         return self.inner.get_world()
@@ -366,6 +369,19 @@ def test_abort_run_is_token_scoped(monkeypatch):
     t.join(30)
     assert not t.is_alive()
     assert eng.abort_run("owner") is False  # idle engine: no-op
+
+    # A tokenless run can never be aborted — None must not match None.
+    t2 = threading.Thread(
+        target=eng.server_distributor, args=(p, world), daemon=True)
+    t2.start()
+    deadline = time.monotonic() + 30
+    while not eng._running:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    assert eng.abort_run(None) is False
+    assert t2.is_alive()
+    eng.cf_put(2)  # FLAG_QUIT to clean up
+    t2.join(30)
 
 
 def test_abort_run_over_the_wire(server, monkeypatch):

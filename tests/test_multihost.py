@@ -18,6 +18,66 @@ def _free_port() -> int:
     return port
 
 
+def test_server_main_joins_cluster(repo_root):
+    """`gol-tpu-server --coordinator …` must initialize jax.distributed
+    BEFORE anything touches the XLA backend (regression: the compile-cache
+    default called jax.default_backend() first and broke every multi-host
+    startup). Two real server processes must join one 8-device cluster
+    and start serving."""
+    import re
+    import subprocess as sp
+    import threading
+
+    coord = _free_port()
+
+    def launcher(pid):
+        return (
+            "import os\nos.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
+            "' --xla_force_host_platform_device_count=4'\n"
+            "import jax\njax.config.update('jax_platforms', 'cpu')\n"
+            "import sys\nsys.argv = ['server', '--port', '0', "
+            f"'--coordinator', '127.0.0.1:{coord}']\n"
+            "from gol_tpu.server import main\nmain()\n")
+
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.update(PYTHONPATH=str(repo_root), GOL_NUM_PROCS="2",
+                   GOL_PROC_ID=str(pid))
+        for k in ("SER", "GOL_COMPILE_CACHE", "XLA_FLAGS"):
+            env.pop(k, None)
+        procs.append(sp.Popen(
+            [sys.executable, "-u", "-c", launcher(pid)],
+            stdout=sp.PIPE, stderr=sp.STDOUT, text=True, env=env,
+            cwd=str(repo_root)))
+    try:
+        results = {}
+
+        def scan(i, p):
+            devices_seen = None
+            for line in p.stdout:
+                m = re.search(r"multi-host engine: process \d/2, (\d+)",
+                              line)
+                if m:
+                    devices_seen = int(m.group(1))
+                if "serving on" in line:
+                    results[i] = devices_seen
+                    return
+
+        threads = [threading.Thread(target=scan, args=(i, p), daemon=True)
+                   for i, p in enumerate(procs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        assert results.get(0) == 8 and results.get(1) == 8, results
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait(10)
+
+
 def test_two_process_mesh_evolution(repo_root):
     port = _free_port()
     worker = str(repo_root / "tests" / "multihost_worker.py")
