@@ -1,0 +1,28 @@
+"""Minimal API usage: evolve a board in-process and read the event
+stream. Run:  python examples/basic_run.py [rulestring]
+
+The same five lines drive a remote engine instead when SER=host:port is
+set (start one with `gol-tpu-server`)."""
+
+import queue
+import sys
+
+from gol_tpu import Params, events as ev, run
+from gol_tpu.models.lifelike import LifeLikeRule
+
+
+def main() -> None:
+    rule = LifeLikeRule(sys.argv[1]) if len(sys.argv) > 1 else None
+    p = Params(threads=8, image_width=64, image_height=64, turns=100)
+    q = queue.Queue()
+    run(p, q, None, rule=rule)  # images/64x64.pgm -> out/64x64x100.pgm
+    for e in ev.drain(q):
+        if isinstance(e, (ev.AliveCellsCount, ev.FinalTurnComplete,
+                          ev.ImageOutputComplete)):
+            print(f"turn {e.completed_turns:>4}: {e}" if str(e)
+                  else f"turn {e.completed_turns:>4}: final "
+                       f"({len(e.alive)} alive)")
+
+
+if __name__ == "__main__":
+    main()
