@@ -1,0 +1,91 @@
+"""Concurrency stress: hammer every read-side RPC from many threads
+while a run is in flight. Every (alive, turn) pair must be coherent
+(the reference's mutex discipline, `Server/gol/distributor.go:131-134,
+173-183`), stats must stay self-consistent, and nothing may deadlock."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.engine import Engine, EngineKilled
+from gol_tpu.ops.reference import run_turns_np
+from gol_tpu.params import Params
+
+
+def test_concurrent_rpc_storm(monkeypatch):
+    monkeypatch.setenv("GOL_MAX_CHUNK", "8")  # frequent state swaps
+    eng = Engine()
+    rng = np.random.default_rng(17)
+    world0 = (rng.random((64, 64)) < 0.3).astype(np.uint8)
+    # Board parity oracle keyed by turn: precompute a window of turns so
+    # every coherent (alive, turn) pair can be checked exactly.
+    turns_total = 160
+    alive_at = {0: int(world0.sum())}
+    b = world0
+    for t in range(1, turns_total + 1):
+        b = run_turns_np(b, 1)
+        alive_at[t] = int(b.sum())
+
+    p = Params(threads=2, image_width=64, image_height=64,
+               turns=turns_total)
+    errors: "queue.Queue[str]" = queue.Queue()
+    stop = threading.Event()
+
+    def alive_reader():
+        while not stop.is_set():
+            alive, turn = eng.alive_count()
+            if turn == 0 and alive == 0:
+                continue  # pre-board-load state (reference parity)
+            if turn in alive_at and alive != alive_at[turn]:
+                errors.put(f"alive({alive}) != {alive_at[turn]} @ {turn}")
+            time.sleep(0.002)
+
+    def world_reader():
+        while not stop.is_set():
+            try:
+                world, turn = eng.get_world()
+            except RuntimeError:
+                continue  # before the board is loaded
+            if turn in alive_at and int((world != 0).sum()) != alive_at[turn]:
+                errors.put(f"world alive mismatch @ {turn}")
+            time.sleep(0.005)
+
+    def stats_reader():
+        while not stop.is_set():
+            s = eng.stats()
+            if s["board"] not in (None, [64, 64]):
+                errors.put(f"bad stats board {s['board']}")
+            if not (0 <= s["turn"] <= turns_total):
+                errors.put(f"bad stats turn {s['turn']}")
+            time.sleep(0.001)
+
+    def pinger():
+        while not stop.is_set():
+            t = eng.ping()
+            if not (0 <= t <= turns_total):
+                errors.put(f"bad ping turn {t}")
+            time.sleep(0.001)
+
+    readers = (
+        [threading.Thread(target=alive_reader, daemon=True) for _ in range(3)]
+        + [threading.Thread(target=world_reader, daemon=True) for _ in range(2)]
+        + [threading.Thread(target=stats_reader, daemon=True),
+           threading.Thread(target=pinger, daemon=True)]
+    )
+    for t in readers:
+        t.start()
+    try:
+        world255 = world0 * 255
+        out, turn = eng.server_distributor(p, world255)
+        assert turn == turns_total
+        np.testing.assert_array_equal(
+            (out != 0).astype(np.uint8),
+            run_turns_np(world0, turns_total))
+    finally:
+        stop.set()
+        for t in readers:
+            t.join(10)
+    assert errors.empty(), [errors.get() for _ in range(errors.qsize())]
