@@ -1,0 +1,139 @@
+"""Generations cellular-automaton family — multi-state rules like
+Brian's Brain ('/2/3') and Star Wars ('345/2/4').
+
+Beyond-reference model family (the Go system is Conway-only,
+`SubServer/distributor.go:179-201`; gol_tpu's life-like family already
+generalises the 2-state rules). A Generations cell is 0 (dead),
+1 (alive), or 2..C-1 (dying): dead cells are born per the birth counts
+of ALIVE (state-1) neighbours, alive cells survive per the survival
+counts or start dying, dying cells count up each turn and then die.
+C = 2 degenerates exactly to the life-like family — a cross-check the
+tests exploit.
+
+Rulestring format is the standard 'survival/birth/states' (e.g.
+'345/2/4'); the kernel is two 9-entry LUT gathers plus a saturating
+increment — one fused XLA program per (shape, turns, rule), shardable
+with the same `shard_map` machinery as the life-like stencil.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_RULE_RE = re.compile(
+    r"^(?P<s>[0-8]*)/(?P<b>[0-8]*)/(?P<c>\d+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationsRule:
+    """'survival/birth/states' rule, canonicalised and hashable (usable
+    as a jit static argument)."""
+
+    rulestring: str = "/2/3"  # Brian's Brain
+
+    def __post_init__(self) -> None:
+        m = _RULE_RE.match(self.rulestring)
+        if m is None:
+            raise ValueError(
+                f"bad Generations rulestring {self.rulestring!r}; "
+                "want 'survival/birth/states', e.g. '/2/3'")
+        c = int(m.group("c"))
+        if c < 2:
+            raise ValueError(f"need at least 2 states, got {c}")
+        canon = (f"{''.join(sorted(set(m.group('s'))))}/"
+                 f"{''.join(sorted(set(m.group('b'))))}/{c}")
+        object.__setattr__(self, "rulestring", canon)
+
+    @property
+    def survive(self) -> frozenset:
+        return frozenset(
+            int(ch) for ch in self.rulestring.split("/")[0])
+
+    @property
+    def born(self) -> frozenset:
+        return frozenset(
+            int(ch) for ch in self.rulestring.split("/")[1])
+
+    @property
+    def states(self) -> int:
+        return int(self.rulestring.split("/")[2])
+
+
+BRIANS_BRAIN = GenerationsRule("/2/3")
+STAR_WARS = GenerationsRule("345/2/4")
+
+
+def _step(state: jax.Array, rule: GenerationsRule) -> jax.Array:
+    """One torus turn of a (H, W) uint8 state board."""
+    alive = (state == 1).astype(jnp.uint8)
+    vert = (jnp.roll(alive, 1, axis=0) + alive
+            + jnp.roll(alive, -1, axis=0))
+    n = (vert + jnp.roll(vert, 1, axis=1) + jnp.roll(vert, -1, axis=1)
+         - alive)  # 8-neighbour count of ALIVE cells
+    born_lut = jnp.array(
+        [1 if i in rule.born else 0 for i in range(9)], dtype=jnp.uint8)
+    surv_lut = jnp.array(
+        [1 if i in rule.survive else 0 for i in range(9)],
+        dtype=jnp.uint8)
+    c = rule.states
+    # dead -> 1 if born; alive -> 1 if surviving else first dying state
+    # (which for C == 2 IS death); dying -> next state, death after C-1.
+    dying_next = jnp.where(state + 1 < c, state + 1, 0).astype(jnp.uint8)
+    out = jnp.where(
+        state == 0,
+        born_lut[n],
+        jnp.where(
+            state == 1,
+            jnp.where(surv_lut[n] == 1, jnp.uint8(1),
+                      jnp.uint8(2 % c)),
+            dying_next,
+        ),
+    )
+    return out.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("num_turns", "rule"))
+def run_turns(
+    state: jax.Array, num_turns: int, rule: GenerationsRule
+) -> jax.Array:
+    """Advance `num_turns` turns in one compiled program."""
+    def body(s, _):
+        return _step(s, rule), None
+    out, _ = lax.scan(body, state, None, length=num_turns)
+    return out
+
+
+class GenerationsTorus:
+    """A multi-state board on a torus; same macro-run surface as the
+    dense engines (`run`, `alive_count`, `board`)."""
+
+    def __init__(self, board: np.ndarray,
+                 rule: GenerationsRule = BRIANS_BRAIN) -> None:
+        board = np.asarray(board, dtype=np.uint8)
+        if board.ndim != 2:
+            raise ValueError("board must be 2-D")
+        if int(board.max(initial=0)) >= rule.states:
+            raise ValueError(
+                f"board has states >= {rule.states} ({rule.rulestring})")
+        self.rule = rule
+        self.turn = 0
+        self._state = jax.device_put(board)
+
+    def run(self, turns: int) -> None:
+        self._state = run_turns(self._state, turns, self.rule)
+        self.turn += turns
+
+    @property
+    def board(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self._state))
+
+    def alive_count(self) -> int:
+        """Cells in state 1 (the 'firing' population)."""
+        return int(jnp.sum(self._state == 1))

@@ -1,0 +1,86 @@
+"""Generations family: naive-oracle parity, C=2 degeneration to the
+life-like kernel, rule parsing, and known pattern behavior."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.models.generations import (
+    BRIANS_BRAIN,
+    STAR_WARS,
+    GenerationsRule,
+    GenerationsTorus,
+)
+from gol_tpu.ops.reference import run_turns_np
+
+
+def naive_generations(board, turns, survive, born, states):
+    board = board.astype(np.int64)
+    h, w = board.shape
+    for _ in range(turns):
+        nxt = np.zeros_like(board)
+        for y in range(h):
+            for x in range(w):
+                n = sum(
+                    board[(y + dy) % h, (x + dx) % w] == 1
+                    for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+                    if (dy, dx) != (0, 0)
+                )
+                s = board[y, x]
+                if s == 0:
+                    nxt[y, x] = 1 if n in born else 0
+                elif s == 1:
+                    nxt[y, x] = 1 if n in survive else (2 % states)
+                else:
+                    nxt[y, x] = s + 1 if s + 1 < states else 0
+        board = nxt
+    return board.astype(np.uint8)
+
+
+def test_rule_parsing_and_canon():
+    assert GenerationsRule("2/2/3").rulestring == "2/2/3"
+    assert GenerationsRule("332/22/4").rulestring == "23/2/4"
+    assert BRIANS_BRAIN.survive == frozenset()
+    assert BRIANS_BRAIN.born == {2}
+    assert STAR_WARS.states == 4
+    for bad in ["", "2/3", "9/2/3", "2/2/1", "a/2/3"]:
+        with pytest.raises(ValueError):
+            GenerationsRule(bad)
+
+
+@pytest.mark.parametrize("rule", [BRIANS_BRAIN, STAR_WARS,
+                                  GenerationsRule("23/3/5")])
+def test_matches_naive_oracle(rule):
+    rng = np.random.default_rng(13)
+    board = rng.integers(0, rule.states, size=(24, 24)).astype(np.uint8)
+    want = naive_generations(board, 12, rule.survive, rule.born,
+                             rule.states)
+    gt = GenerationsTorus(board, rule)
+    gt.run(12)
+    np.testing.assert_array_equal(gt.board, want)
+    assert gt.turn == 12
+    assert gt.alive_count() == int((want == 1).sum())
+
+
+def test_c2_degenerates_to_conway():
+    # '23/3/2' IS Conway: no dying states, survive-or-die.
+    rng = np.random.default_rng(29)
+    board = (rng.random((32, 32)) < 0.4).astype(np.uint8)
+    gt = GenerationsTorus(board, GenerationsRule("23/3/2"))
+    gt.run(20)
+    np.testing.assert_array_equal(gt.board, run_turns_np(board, 20))
+
+
+def test_brians_brain_everything_dies_without_pairs():
+    # A single firing cell: no cell ever has exactly 2 firing neighbours,
+    # so the board burns out to all-dead in 2 turns.
+    board = np.zeros((16, 16), dtype=np.uint8)
+    board[8, 8] = 1
+    gt = GenerationsTorus(board)
+    gt.run(2)
+    assert gt.board.sum() == 0
+
+
+def test_rejects_out_of_range_states():
+    board = np.full((4, 4), 3, dtype=np.uint8)
+    with pytest.raises(ValueError):
+        GenerationsTorus(board, BRIANS_BRAIN)  # states must be < 3
