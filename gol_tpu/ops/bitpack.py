@@ -111,8 +111,21 @@ def _rule_from_count_bits(
             return n1 & ~n2 & ~n3 & (n0 | mid)
         # next = n9==3 | (alive & n9==4)
         return ~n3 & ((~n2 & n1 & n0) | (mid & n2 & ~n1 & ~n0))
-    ones = jnp.uint32(0xFFFFFFFF)
+    born, survive = rule_masks(
+        n0, n1, n2, n3, rule.born, rule.survive, count_offset)
+    return (~mid & born) | (mid & survive)
+
+
+def rule_masks(n0, n1, n2, n3, born_set, survive_set,
+               count_offset: int = 0):
+    """(born_mask, survive_mask) from bit-sliced neighbour counts: bit i
+    of born_mask is set iff cell i's count ∈ born_set (likewise survive,
+    shifted by `count_offset` for self-inclusive counts). Shared by the
+    life-like rule application above and the multi-state Generations
+    packed kernel (`models/generations.py`), which combines the masks
+    with its own state planes."""
     bits = (n0, n1, n2, n3)
+    ones = jnp.uint32(0xFFFFFFFF)
 
     def eq(k: int) -> jax.Array:
         m = ones
@@ -120,12 +133,12 @@ def _rule_from_count_bits(
             m &= b if (k >> i) & 1 else ~b
         return m
 
-    zero = jnp.zeros_like(mid)
+    zero = jnp.zeros_like(n0)
     born = functools.reduce(
-        lambda a, k: a | eq(k), sorted(rule.born), zero)
+        lambda a, k: a | eq(k), sorted(born_set), zero)
     survive = functools.reduce(
-        lambda a, k: a | eq(k + count_offset), sorted(rule.survive), zero)
-    return (~mid & born) | (mid & survive)
+        lambda a, k: a | eq(k + count_offset), sorted(survive_set), zero)
+    return born, survive
 
 
 def packed_step(packed: jax.Array, rule: LifeLikeRule = CONWAY) -> jax.Array:

@@ -61,6 +61,34 @@ def test_matches_naive_oracle(rule):
     assert gt.alive_count() == int((want == 1).sum())
 
 
+def test_packed_c3_matches_unpacked_kernel():
+    # 32-aligned width + 3 states → the bit-plane packed path; must be
+    # cell-identical to the uint8 LUT kernel and the naive oracle.
+    import jax.numpy as jnp
+
+    from gol_tpu.models.generations import run_turns
+
+    rng = np.random.default_rng(41)
+    board = rng.integers(0, 3, size=(64, 64)).astype(np.uint8)
+    gt = GenerationsTorus(board, BRIANS_BRAIN)
+    assert gt._packed
+    gt.run(30)
+    want = np.asarray(run_turns(jnp.asarray(board), 30, BRIANS_BRAIN))
+    np.testing.assert_array_equal(gt.board, want)
+    assert gt.alive_count() == int((want == 1).sum())
+    small = naive_generations(board, 30, frozenset(), {2}, 3)
+    np.testing.assert_array_equal(gt.board, small)
+
+
+def test_unaligned_width_uses_unpacked_path():
+    board = np.zeros((8, 24), dtype=np.uint8)
+    board[4, 4] = 1
+    gt = GenerationsTorus(board, BRIANS_BRAIN)
+    assert not gt._packed
+    gt.run(1)
+    assert gt.board[4, 4] == 2  # alive with no pair of neighbours → dying
+
+
 def test_c2_degenerates_to_conway():
     # '23/3/2' IS Conway: no dying states, survive-or-die.
     rng = np.random.default_rng(29)
