@@ -62,6 +62,8 @@ from gol_tpu.events import (
     AliveCellsCount,
     CellFlipped,
     CellsFlipped,
+    EngineLost,
+    EngineReattached,
     Event,
     FinalTurnComplete,
     ImageOutputComplete,
@@ -80,9 +82,13 @@ __all__ = [
     "AliveCellsCount",
     "CellFlipped",
     "CellsFlipped",
+    "EngineLost",
+    "EngineReattached",
     "FinalTurnComplete",
     "ImageOutputComplete",
     "State",
     "StateChange",
     "TurnComplete",
+    "enable_compile_cache",
+    "default_compile_cache_dir",
 ]

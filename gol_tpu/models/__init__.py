@@ -1,3 +1,32 @@
-from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+from gol_tpu.models.generations import (
+    BRIANS_BRAIN,
+    STAR_WARS,
+    GenerationsRule,
+    GenerationsTorus,
+)
+from gol_tpu.models.lifelike import (
+    CONWAY,
+    DAY_AND_NIGHT,
+    HIGHLIFE,
+    SEEDS,
+    LifeLikeRule,
+)
+from gol_tpu.models.patterns import PATTERNS, pattern_cells, stamp
+from gol_tpu.models.sparse import R_PENTOMINO, SparseTorus
 
-__all__ = ["CONWAY", "LifeLikeRule"]
+__all__ = [
+    "BRIANS_BRAIN",
+    "CONWAY",
+    "DAY_AND_NIGHT",
+    "HIGHLIFE",
+    "PATTERNS",
+    "R_PENTOMINO",
+    "SEEDS",
+    "STAR_WARS",
+    "GenerationsRule",
+    "GenerationsTorus",
+    "LifeLikeRule",
+    "SparseTorus",
+    "pattern_cells",
+    "stamp",
+]
