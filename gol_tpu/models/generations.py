@@ -47,6 +47,10 @@ class GenerationsRule:
         c = int(m.group("c"))
         if c < 2:
             raise ValueError(f"need at least 2 states, got {c}")
+        if c > 256:
+            # Cells live in uint8 boards; a dying counter past 255 would
+            # silently wrap and kill cells at the wrong turn.
+            raise ValueError(f"at most 256 states, got {c}")
         canon = (f"{''.join(sorted(set(m.group('s'))))}/"
                  f"{''.join(sorted(set(m.group('b'))))}/{c}")
         object.__setattr__(self, "rulestring", canon)
@@ -196,6 +200,7 @@ class GenerationsTorus:
     def alive_count(self) -> int:
         """Cells in state 1 (the 'firing' population)."""
         if self._packed:
-            return int(jnp.sum(
-                lax.population_count(self._a), dtype=jnp.int32))
+            from gol_tpu.ops.bitpack import packed_alive_count
+
+            return packed_alive_count(self._a)
         return int(jnp.sum(self._state == 1))

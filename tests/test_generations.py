@@ -42,9 +42,10 @@ def test_rule_parsing_and_canon():
     assert BRIANS_BRAIN.survive == frozenset()
     assert BRIANS_BRAIN.born == {2}
     assert STAR_WARS.states == 4
-    for bad in ["", "2/3", "9/2/3", "2/2/1", "a/2/3"]:
+    for bad in ["", "2/3", "9/2/3", "2/2/1", "a/2/3", "/2/300"]:
         with pytest.raises(ValueError):
             GenerationsRule(bad)
+    GenerationsRule("/2/256")  # the uint8 ceiling itself is fine
 
 
 @pytest.mark.parametrize("rule", [BRIANS_BRAIN, STAR_WARS,
