@@ -92,6 +92,55 @@ def test_pgm_reader_fuzz(tmp_path):
             pass
 
 
+def test_server_dispatch_fuzz():
+    """Random well-formed JSON headers (junk methods, junk fields, wrong
+    types) against a live server: every request gets either an error
+    reply or a dropped connection, and the server keeps serving."""
+    import json
+
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import Engine
+    from gol_tpu.server import EngineServer
+    from gol_tpu.wire import send_msg, recv_msg
+
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    rng = np.random.default_rng(77)
+    methods = ["ServerDistributor", "Alivecount", "GetWorld", "CFput",
+               "DrainFlags", "Ping", "Stats", "AbortRun", "NoSuch", "",
+               None, 42]
+    junk_values = [None, 0, -1, "x", [], {}, {"h": 1}, 1e308, True]
+    try:
+        for i in range(120):
+            header = {"method": methods[int(rng.integers(len(methods)))]}
+            for _ in range(int(rng.integers(0, 4))):
+                key = ["params", "flag", "token", "start_turn",
+                       "sub_workers", "world", "extra"][
+                           int(rng.integers(7))]
+                header[key] = junk_values[int(rng.integers(
+                    len(junk_values)))]
+            try:
+                json.dumps(header)
+            except (TypeError, ValueError):
+                continue
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5)
+            try:
+                send_msg(s, header)
+                resp, _ = recv_msg(s)
+                assert isinstance(resp, dict)
+            except (ConnectionError, OSError):
+                pass  # dropped connection is an acceptable rejection
+            finally:
+                s.close()
+        # the server must still serve a well-formed client
+        eng = RemoteEngine(f"127.0.0.1:{srv.port}")
+        assert eng.ping() == 0
+        assert eng.stats()["devices"] >= 1
+    finally:
+        srv.shutdown()
+
+
 def test_pgm_round_trip_fuzz(tmp_path):
     rng = np.random.default_rng(11)
     path = str(tmp_path / "rt.pgm")
