@@ -91,23 +91,27 @@ def _unpack_words(words) -> np.ndarray:
     return np.asarray(unpack(jnp.asarray(np.asarray(words))))
 
 
-def bench_rpentomino(turns: int) -> int:
-    """BASELINE config 5: R-pentomino on a 2^20 sparse torus — stresses
-    the expanding-window sparse engine + popcount alive reduction.
+def bench_sparse(turns: int, pattern: str = "rpentomino") -> int:
+    """BASELINE config 5: a small pattern on a 2^20 sparse torus —
+    stresses the expanding-window sparse engine + popcount alive
+    reduction. `pattern` is any library pattern name (the BASELINE
+    config is the R-pentomino; others are exploratory).
 
     Parity gate: alive count at `min(turns, 896)` vs a host replay on a
     2048² window — light-cone safe (influence spreads ≤1 cell/turn, so
     2·896 + the seed's extent stays inside 2048), and 896 turns is deep
     in the R-pentomino's chaotic phase, a strong correctness signal."""
-    from gol_tpu.models.sparse import R_PENTOMINO, SparseTorus
+    from gol_tpu.models.patterns import pattern_cells
+    from gol_tpu.models.sparse import SparseTorus
 
     size = 2**20
-    start = [(x + size // 2, y + size // 2) for x, y in R_PENTOMINO]
+    cells = pattern_cells(pattern)
+    start = [(x + size // 2, y + size // 2) for x, y in cells]
 
     check_turns = min(turns, 896)
     win = 2048
     board = np.zeros((win, win), dtype=np.uint8)
-    for x, y in R_PENTOMINO:
+    for x, y in cells:
         board[y + win // 2, x + win // 2] = 1
     want_alive = int(_host_step_turns(board, check_turns).sum())
     check = SparseTorus(size, start)
@@ -125,8 +129,9 @@ def bench_rpentomino(turns: int) -> int:
     alive = sp.alive_count()
     elapsed = time.perf_counter() - t0
     h, w = sp.window_shape()
+    label = "R-pentomino" if pattern == "rpentomino" else pattern
     _emit(
-        "turns/sec (R-pentomino, 2^20 sparse torus)",
+        f"turns/sec ({label}, 2^20 sparse torus)",
         round(turns / elapsed, 1), "turns/s", None,
         {"turns": turns, "elapsed_s": round(elapsed, 4), "alive": alive,
          "window": [h, w], "alive_parity": parity,
@@ -261,13 +266,18 @@ def main() -> int:
                          "matrix legs each need a latency-amortising "
                          "count of their own (see module docstring)")
     ap.add_argument("--warmup-turns", type=int, default=128)
-    ap.add_argument("--pattern", choices=["dense", "rpentomino"],
-                    default="dense")
+    from gol_tpu.models.patterns import PATTERNS
+
+    ap.add_argument("--pattern",
+                    choices=["dense"] + sorted(PATTERNS),
+                    default="dense",
+                    help="'dense' (default) or a sparse-torus pattern "
+                         "(rpentomino = BASELINE config 5)")
     args = ap.parse_args()
 
-    if args.pattern == "rpentomino":
+    if args.pattern != "dense":
         turns = args.turns if args.turns is not None else SPARSE_TURNS
-        return bench_rpentomino(turns)
+        return bench_sparse(turns, args.pattern)
 
     if args.size is not None:
         turns = (args.turns if args.turns is not None
@@ -297,7 +307,7 @@ def main() -> int:
 
     for n in (5120, 65536):
         rc |= leg(bench_dense, n, default_turns(n), args.warmup_turns)
-    rc |= leg(bench_rpentomino, SPARSE_TURNS)
+    rc |= leg(bench_sparse, SPARSE_TURNS)
     rc |= leg(bench_dense, 512, default_turns(512), args.warmup_turns)
     return rc
 
