@@ -1,0 +1,22 @@
+# Convenience targets; everything also works as plain commands.
+
+.PHONY: test bench native fixtures clean
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+native:
+	$(MAKE) -C csrc
+
+# Regenerate golden fixtures from the independent numpy oracle
+# (check/images, check/alive; see tests/make_fixtures.py).
+fixtures:
+	python tests/make_fixtures.py
+
+clean:
+	$(MAKE) -C csrc clean 2>/dev/null || true
+	rm -rf out .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
