@@ -53,6 +53,17 @@ def test_ping_roundtrip(server):
     assert eng.ping() == 8
 
 
+def test_heartbeat_disabled(server, monkeypatch):
+    """GOL_HB_INTERVAL=0 disables the watchdog; runs still work."""
+    monkeypatch.setenv("GOL_HB_INTERVAL", "0")
+    eng = RemoteEngine(f"127.0.0.1:{server.port}")
+    world = np.zeros((16, 16), dtype=np.uint8)
+    world[4:7, 5] = 255
+    p = Params(threads=1, image_width=16, image_height=16, turns=6)
+    out, turn = eng.server_distributor(p, world)
+    assert turn == 6 and (out != 0).sum() == 3
+
+
 def test_new_event_strings():
     assert str(ev.EngineLost(7)) == "Engine connection lost"
     assert str(ev.EngineReattached(7)) == "Engine connection restored"
