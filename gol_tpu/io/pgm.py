@@ -105,6 +105,14 @@ def write_pgm(path: str, board: np.ndarray) -> None:
     if board.dtype != np.uint8 or board.ndim != 2:
         raise ValueError(f"board must be 2-D uint8, got {board.dtype} "
                          f"shape {board.shape}")
+    bad = (board != 0) & (board != MAXVAL)
+    if bad.any():
+        # Fail at the write site — the usual bug is passing the internal
+        # {0,1} cells array instead of pixels; writing it would produce a
+        # file read_pgm itself rejects, far from the cause.
+        raise ValueError(
+            f"{int(bad.sum())} cells not in {{0, {MAXVAL}}} "
+            "(pass pixels, not {0,1} cells)")
     from gol_tpu import native
 
     height, width = board.shape

@@ -155,6 +155,15 @@ def read_pgm(path: str) -> Optional[np.ndarray]:
         raise FileNotFoundError(path)
     if rc != 0:
         raise HeaderParseError(f"{path}: bad PGM header (native rc {rc})")
+    # Bound the allocation by the file itself before trusting the header
+    # dims (a 30-byte file claiming 1e8 x 1e8 must not drive np.empty
+    # into the petabytes; the Python fallback is implicitly bounded
+    # because it slices a fully-read buffer).
+    cells = w.value * h.value
+    if cells > os.path.getsize(path):
+        raise ValueError(
+            f"{path}: header claims {cells} payload bytes but the file "
+            f"is only {os.path.getsize(path)} bytes")
     board = np.empty((h.value, w.value), dtype=np.uint8)
     rc = l.gol_pgm_read_payload(
         path.encode(), off.value, board, w.value * h.value)

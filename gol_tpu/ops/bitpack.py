@@ -35,8 +35,13 @@ WORD_BITS = 32
 _U = jnp.uint32
 
 
+@jax.jit
 def pack(cells: jax.Array) -> jax.Array:
-    """{0,1} uint8 (..., H, W) → uint32 (..., H, W/32), LSB-first."""
+    """{0,1} uint8 (..., H, W) → uint32 (..., H, W/32), LSB-first.
+
+    Jitted so XLA fuses the 32-bit-per-cell broadcast/multiply into the
+    reduction — eager, the (…, W/32, 32) uint32 intermediates would
+    transiently cost ~16x the board (64 GiB for the 65536² flagship)."""
     w = cells.shape[-1]
     if w % WORD_BITS != 0:
         raise ValueError(f"width {w} not a multiple of {WORD_BITS}")
@@ -45,6 +50,7 @@ def pack(cells: jax.Array) -> jax.Array:
     return jnp.sum(lanes.astype(_U) * weights, axis=-1, dtype=_U)
 
 
+@jax.jit
 def unpack(packed: jax.Array) -> jax.Array:
     """uint32 (..., H, Wp) → {0,1} uint8 (..., H, Wp*32)."""
     shifts = jnp.arange(WORD_BITS, dtype=_U)

@@ -178,25 +178,31 @@ def _packed_deep_macro(
     """One macro-step: exchange T-row halos, advance the window T turns
     (`inner`: 'banded[-interpret]' | 'pallas[-interpret]' | 'jnp'), keep
     the exact middle."""
+    top, bot = _exchange_row_halos(local, n_shards, depth=T)
+    window = jnp.concatenate([top, local, bot], axis=0)
+    return run_window(window, T, rule, inner)[T:-T]
+
+
+def run_window(window: jax.Array, T: int, rule: LifeLikeRule, inner: str):
+    """Advance a haloed per-shard window T torus turns with the engine
+    named by `inner` (an `inner_kind` result, plus the '-interpret'
+    variants tests use). The single dispatch point shared by the 1-D and
+    2-D deep-halo macros — one place to add a kernel kind."""
     from gol_tpu.ops.bitpack import packed_run_turns
     from gol_tpu.ops.pallas_stencil import (
         banded_packed_run_turns,
         pallas_packed_run_turns,
     )
 
-    top, bot = _exchange_row_halos(local, n_shards, depth=T)
-    window = jnp.concatenate([top, local, bot], axis=0)
     if inner == "banded":
-        window = banded_packed_run_turns(window, T, rule)
-    elif inner == "banded-interpret":
-        window = banded_packed_run_turns(window, T, rule, interpret=True)
-    elif inner == "pallas":
-        window = pallas_packed_run_turns(window, T, rule)
-    elif inner == "pallas-interpret":
-        window = pallas_packed_run_turns(window, T, rule, interpret=True)
-    else:
-        window = packed_run_turns(window, T, rule)
-    return window[T:-T]
+        return banded_packed_run_turns(window, T, rule)
+    if inner == "banded-interpret":
+        return banded_packed_run_turns(window, T, rule, interpret=True)
+    if inner == "pallas":
+        return pallas_packed_run_turns(window, T, rule)
+    if inner == "pallas-interpret":
+        return pallas_packed_run_turns(window, T, rule, interpret=True)
+    return packed_run_turns(window, T, rule)
 
 
 @functools.lru_cache(maxsize=128)

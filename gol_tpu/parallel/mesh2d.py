@@ -29,7 +29,6 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
-from gol_tpu.ops.bitpack import packed_run_turns
 from gol_tpu.parallel.halo import inner_kind
 from gol_tpu.parallel.mesh import ROWS_AXIS
 
@@ -72,11 +71,7 @@ def _macro_2d(
     inner: str,
 ):
     """One T-turn macro-step of one (rows, wcols) shard."""
-    from gol_tpu.ops.pallas_stencil import (
-        banded_packed_run_turns,
-        pallas_packed_run_turns,
-    )
-    from gol_tpu.parallel.halo import exchange_halos
+    from gol_tpu.parallel.halo import exchange_halos, run_window
 
     # Vertical: T rows from the ring neighbours above/below.
     top, bot = exchange_halos(local, n_rows, ROWS_AXIS, depth=T, axis=0)
@@ -85,20 +80,7 @@ def _macro_2d(
     # taken from the row-extended window so corners are included.
     west, east = exchange_halos(tall, n_cols, COLS_AXIS, depth=1, axis=1)
     window = jnp.concatenate([west, tall, east], axis=1)
-    # (The +2-word horizontal halo makes the window's word axis almost
-    # never 128-lane aligned, so 'banded' is rare here — but inner_kind
-    # is shared with the 1-D path, so honour every kind it can emit.)
-    if inner == "banded":
-        window = banded_packed_run_turns(window, T, rule)
-    elif inner == "banded-interpret":
-        window = banded_packed_run_turns(window, T, rule, interpret=True)
-    elif inner == "pallas":
-        window = pallas_packed_run_turns(window, T, rule)
-    elif inner == "pallas-interpret":
-        window = pallas_packed_run_turns(window, T, rule, interpret=True)
-    else:
-        window = packed_run_turns(window, T, rule)
-    return window[T:-T, 1:-1]
+    return run_window(window, T, rule, inner)[T:-T, 1:-1]
 
 
 @functools.lru_cache(maxsize=128)
@@ -148,11 +130,15 @@ def sharded_packed_run_turns_2d(
             f"{n_rows}x{n_cols}")
     shard_rows, shard_cols = h // n_rows, wp // n_cols
     T = min(MAX_T_2D, shard_rows)
-    window_shape = (shard_rows + 2 * T, shard_cols + 2)
-    inner = inner_kind(mesh, window_shape)
+    inner = inner_kind(mesh, (shard_rows + 2 * T, shard_cols + 2))
     run = _make_compiled_run2d(mesh, rule, T, inner)
     full, rem = divmod(num_turns, T)
     out = run(packed, full)
     if rem:
-        out = _make_compiled_run2d(mesh, rule, rem, inner)(out, 1)
+        # The remainder window has a DIFFERENT height — re-pick the inner
+        # engine for it (e.g. a height whose banded band sizing worked at
+        # depth T may have no viable band at depth rem).
+        inner_rem = inner_kind(mesh, (shard_rows + 2 * rem,
+                                      shard_cols + 2))
+        out = _make_compiled_run2d(mesh, rule, rem, inner_rem)(out, 1)
     return out

@@ -21,10 +21,19 @@ from gol_tpu.sdl.window import Window
 
 def _stdin_key_reader(key_presses: "queue.Queue", stop: threading.Event):
     """Stdin reader thread: forwards s/p/q/k keystrokes. Terminal mode is
-    owned by `start()` (set + restored there), because this thread blocks
-    in read(1) and is killed without unwinding at process exit — a finally
-    here would never run."""
+    owned by `start()` (set + restored there). select() gates every
+    read(1) so the thread actually exits when `stop` is set — a reader
+    parked in a blocking read would outlive its run and steal the user's
+    next keystroke (or race a later `start()`'s reader for stdin)."""
+    import select
+
     while not stop.is_set():
+        try:
+            ready, _, _ = select.select([sys.stdin], [], [], 0.2)
+        except (OSError, ValueError):  # stdin closed under us
+            return
+        if not ready:
+            continue
         ch = sys.stdin.read(1)
         if not ch:
             return

@@ -63,6 +63,13 @@ class Window:
         self._pixels[y % self.height, x % self.width] ^= True
 
     def set_board(self, board01: np.ndarray) -> None:
+        if board01.shape != (self.height, self.width):
+            # The SDL render path hands SDL a buffer sized from
+            # (height, width); a mismatched board would be a native
+            # out-of-bounds read.
+            raise ValueError(
+                f"board {board01.shape} != window "
+                f"({self.height}, {self.width})")
         self._pixels = board01.astype(bool)
 
     # --- rendering --------------------------------------------------------
@@ -125,6 +132,10 @@ class Window:
     # --- SDL internals ----------------------------------------------------
 
     def _init_sdl(self, scale: int) -> None:
+        """Bring up window + renderer + texture, or tear everything down
+        and leave `_sdl` unset so the ANSI fallback takes over — a
+        half-initialized chain (e.g. no usable render driver on a remote
+        X display) must not leave a black frozen window."""
         if _SDL.SDL_Init(_SDL_INIT_VIDEO) != 0:
             return
         _SDL.SDL_CreateWindow.restype = ctypes.c_void_p
@@ -137,6 +148,7 @@ class Window:
             0,
         )
         if not self._win:
+            _SDL.SDL_Quit()
             return
         _SDL.SDL_CreateRenderer.restype = ctypes.c_void_p
         self._ren = _SDL.SDL_CreateRenderer(
@@ -149,7 +161,12 @@ class Window:
             _SDL_TEXTUREACCESS_STREAMING,
             self.width,
             self.height,
-        )
+        ) if self._ren else None
+        if not self._ren or not self._tex:
+            _SDL.SDL_DestroyWindow(ctypes.c_void_p(self._win))
+            _SDL.SDL_Quit()
+            self._win = self._ren = self._tex = None
+            return
         self._sdl = _SDL
 
     def _render_sdl(self) -> None:

@@ -47,15 +47,19 @@ def send_msg(
     sock: socket.socket, header: dict, world: Optional[np.ndarray] = None
 ) -> None:
     header = dict(header)
-    payload = b""
+    payload = None
     if world is not None:
         if world.dtype != np.uint8 or world.ndim != 2:
             raise ValueError("world must be 2-D uint8")
         h, w = world.shape
         header["world"] = {"h": int(h), "w": int(w)}
-        payload = world.tobytes()
+        # Send the board's own buffer — tobytes() + concatenation would
+        # transiently double a multi-GB snapshot.
+        payload = memoryview(np.ascontiguousarray(world)).cast("B")
     raw = json.dumps(header).encode()
-    sock.sendall(_LEN.pack(len(raw)) + raw + payload)
+    sock.sendall(_LEN.pack(len(raw)) + raw)
+    if payload is not None:
+        sock.sendall(payload)
 
 
 def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
@@ -79,7 +83,14 @@ def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
             raise ConnectionError(f"malformed world dims: {e}") from e
         if h <= 0 or w <= 0 or h * w > MAX_BOARD_CELLS:
             raise ConnectionError(f"board dims out of bounds: {h}x{w}")
-        world = np.frombuffer(
-            _recv_exact(sock, h * w), dtype=np.uint8
-        ).reshape(h, w).copy()
+        # Receive straight into the final array — going through bytes
+        # would peak at ~3x the payload for a multi-GB snapshot.
+        world = np.empty((h, w), dtype=np.uint8)
+        mv = memoryview(world).cast("B")
+        got = 0
+        while got < h * w:
+            n_read = sock.recv_into(mv[got:])
+            if n_read == 0:
+                raise ConnectionError("peer closed mid-message")
+            got += n_read
     return header, world
