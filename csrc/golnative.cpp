@@ -19,9 +19,11 @@
 // C ABI only (consumed via ctypes from gol_tpu/native.py). All functions
 // return 0 on success or a negative errno-style code.
 
+#include <cctype>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>

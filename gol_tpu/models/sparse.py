@@ -89,13 +89,29 @@ def _fused_run(shape, num_turns: int, rule: LifeLikeRule, kind: str):
 
 @jax.jit
 def _occupancy(packed: jax.Array):
-    rows = jnp.sum(lax.population_count(packed), axis=1, dtype=jnp.int32)
+    from gol_tpu.ops.bitpack import _row_popcounts
+
+    rows = _row_popcounts(packed)
     cols = jnp.sum(lax.population_count(packed), axis=0, dtype=jnp.int32)
     return rows, cols
 
 
 def _round_up(v: int, align: int) -> int:
     return -(-v // align) * align
+
+
+def _cyclic_extent(coords, size: int):
+    """(origin, extent) of the tightest arc covering `coords` on a
+    `size`-cycle: anchor just past the largest gap between consecutive
+    occupied positions, wrapping included."""
+    uniq = sorted(set(coords))
+    if len(uniq) == 1:
+        return uniq[0], 1
+    gaps = [(uniq[i + 1] - uniq[i], uniq[i + 1])
+            for i in range(len(uniq) - 1)]
+    gaps.append((uniq[0] + size - uniq[-1], uniq[0]))
+    biggest, origin = max(gaps)
+    return origin, size - biggest + 1
 
 
 class SparseTorus:
@@ -123,9 +139,11 @@ class SparseTorus:
             raise ValueError("need at least one live cell")
         xs = [c[0] % size for c in cells]
         ys = [c[1] % size for c in cells]
-        x0, y0 = min(xs), min(ys)
-        w = max(xs) - x0 + 1
-        h = max(ys) - y0 + 1
+        # Cyclic bounding box: a pattern straddling the torus seam (e.g.
+        # cells at x = size-1 and x = 0) is small, not torus-spanning —
+        # anchor each axis after its largest cyclic gap.
+        x0, w = _cyclic_extent(xs, size)
+        y0, h = _cyclic_extent(ys, size)
         if w > size // 2 or h > size // 2:
             raise ValueError(
                 "pattern spans most of the torus — use the dense engine")
@@ -215,7 +233,7 @@ class SparseTorus:
         pad_left_words = ((new_w - live_w) // 2) // WORD_BITS
         new = jnp.zeros((new_h, new_w // WORD_BITS),
                         dtype=self._packed.dtype)
-        src = self._packed[top:h - bottom if bottom else h, :]
+        src = self._packed[top:h - bottom, :]
         src = src[:, left // WORD_BITS: wp - right // WORD_BITS]
         new = lax.dynamic_update_slice(
             new, src, (pad_top, pad_left_words))
@@ -249,7 +267,19 @@ class SparseTorus:
             m = self._margins()
         if m is None:
             return -1  # pattern died out
-        mm = min(m)
+        # A dimension capped at the full torus needs no margin at all —
+        # its window wrap IS the real torus wrap. Excluding it stops a
+        # saturated axis's zero margin from forcing a (futile) grow
+        # before every macro-step.
+        h, wp = self._packed.shape
+        relevant = []
+        if h < self.size:
+            relevant += [m[0], m[1]]
+        if wp * WORD_BITS < self.size:
+            relevant += [m[2], m[3]]
+        if not relevant:
+            return target  # fully saturated: plain torus stepping
+        mm = min(relevant)
         if target <= mm - 1:
             return target
         k = _ladder_floor(mm - 1)  # < target here, since target > mm - 1

@@ -49,11 +49,23 @@ def ensure_built(quiet: bool = True) -> bool:
         )
         # Equal source/.so mtimes count as stale here (same-second git
         # checkouts) but make treats them as up to date and won't rebuild
-        # — bump the .so mtime after a successful pass so the NEXT import
-        # doesn't fork make again forever.
-        if _LIB_PATH.exists() and _so_is_stale() \
-                and not os.environ.get("GOL_NATIVE_FRESHEN"):
-            os.utime(_LIB_PATH)
+        # — bump the .so mtime so the NEXT import doesn't fork make again
+        # forever. ONLY for the exact-equality case: a source STRICTLY
+        # newer than the .so after make ran means make's own dependency
+        # graph declined a rebuild this pass (or it failed), and bumping
+        # would mask genuinely newer sources behind a stale oracle.
+        if _LIB_PATH.exists() and not os.environ.get("GOL_NATIVE_FRESHEN"):
+            try:
+                so_mtime = _LIB_PATH.stat().st_mtime
+                newest_src = max(
+                    (p.stat().st_mtime
+                     for p in (_REPO_ROOT / "csrc").glob("*")
+                     if p.is_file()),
+                    default=0.0)
+                if newest_src == so_mtime:
+                    os.utime(_LIB_PATH)
+            except OSError:
+                pass
     except (OSError, subprocess.SubprocessError):
         pass  # no toolchain: fall through — a previous build still counts
     return _LIB_PATH.exists()
@@ -152,7 +164,12 @@ def read_pgm(path: str) -> Optional[np.ndarray]:
     rc = l.gol_pgm_read_header(
         path.encode(), ctypes.byref(w), ctypes.byref(h), ctypes.byref(off))
     if rc == -1:
-        raise FileNotFoundError(path)
+        # Native fopen failed but doesn't say why; let Python's open
+        # raise the ACCURATE OSError subclass (FileNotFoundError,
+        # PermissionError, IsADirectoryError, ...).
+        open(path, "rb").close()
+        raise HeaderParseError(
+            f"{path}: unreadable by the native codec")
     if rc != 0:
         raise HeaderParseError(f"{path}: bad PGM header (native rc {rc})")
     # Bound the allocation by the file itself before trusting the header

@@ -19,6 +19,7 @@ stencil halo.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -234,18 +235,24 @@ def _make_compiled_deep_run(
     return run
 
 
-def inner_kind(mesh: Mesh, window_shape) -> str:
+def inner_kind(mesh: Mesh, window_shape, T: Optional[int] = None) -> str:
     """Per-shard engine for a deep-halo window — the same preference
     order as the single-device dispatch (`packed_run_kind`): the banded
     HBM kernel when the window's word axis is lane-aligned (the fastest
     tier, and the only one that scales to per-shard windows beyond VMEM),
     else the whole-window VMEM kernel when it fits, else the jnp packed
-    scan. Shared by the 1-D and 2-D deep-halo paths."""
+    scan. Shared by the 1-D and 2-D deep-halo paths.
+
+    When the macro depth `T` is given, 'banded' is only reported if the
+    banded kernel would actually sweep that depth (its Mosaic DMA needs
+    8-sublane-aligned halo depths) — otherwise its internal fallback
+    would silently run the jnp scan under a 'banded' label."""
     from gol_tpu.ops.pallas_stencil import banded_supported, fits_in_vmem
 
     platform = mesh.devices.flat[0].platform
     if platform == "tpu":
-        if banded_supported(window_shape):
+        if banded_supported(window_shape) and (
+                T is None or T % 8 == 0 or fits_in_vmem(window_shape)):
             return "banded"
         if fits_in_vmem(window_shape):
             return "pallas"
@@ -311,7 +318,7 @@ def sharded_packed_run_turns(
     T = _deep_halo_T(num_turns, shard_rows)
     if T > 1:
         window_shape = (shard_rows + 2 * T, packed.shape[-1])
-        inner = inner_kind(mesh, window_shape)
+        inner = inner_kind(mesh, window_shape, T)
         run = _make_compiled_deep_run(mesh, rule, T, inner)
         return run(packed, num_turns // T)
     return _make_compiled_run(mesh, rule, _packed_local_step)(

@@ -130,15 +130,15 @@ def sharded_packed_run_turns_2d(
             f"{n_rows}x{n_cols}")
     shard_rows, shard_cols = h // n_rows, wp // n_cols
     T = min(MAX_T_2D, shard_rows)
-    inner = inner_kind(mesh, (shard_rows + 2 * T, shard_cols + 2))
+    inner = inner_kind(mesh, (shard_rows + 2 * T, shard_cols + 2), T)
     run = _make_compiled_run2d(mesh, rule, T, inner)
     full, rem = divmod(num_turns, T)
     out = run(packed, full)
     if rem:
-        # The remainder window has a DIFFERENT height — re-pick the inner
-        # engine for it (e.g. a height whose banded band sizing worked at
-        # depth T may have no viable band at depth rem).
-        inner_rem = inner_kind(mesh, (shard_rows + 2 * rem,
-                                      shard_cols + 2))
+        # The remainder window has a DIFFERENT height and depth — re-pick
+        # the inner engine for it (a height whose banded band sizing
+        # worked at depth T may have no viable band at depth rem).
+        inner_rem = inner_kind(
+            mesh, (shard_rows + 2 * rem, shard_cols + 2), rem)
         out = _make_compiled_run2d(mesh, rule, rem, inner_rem)(out, 1)
     return out

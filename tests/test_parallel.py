@@ -181,3 +181,9 @@ def test_inner_kind_prefers_banded_for_aligned_windows():
     assert inner_kind(FakeMesh, (160, 128)) == "banded"
     assert inner_kind(FakeMesh, (160, 16)) == "pallas"   # 512-wide board
     assert inner_kind(FakeMesh, (70000, 16)) == "jnp"    # beyond VMEM
+    # Depth-aware honesty: a giant banded-eligible window at a depth the
+    # banded kernel cannot sweep (not 8-aligned, window beyond VMEM)
+    # must report the jnp engine that would actually run.
+    assert inner_kind(FakeMesh, (70000, 2048), 4) == "jnp"
+    assert inner_kind(FakeMesh, (70000, 2048), 16) == "banded"
+    assert inner_kind(FakeMesh, (160, 128), 4) == "banded"  # fits VMEM

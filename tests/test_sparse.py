@@ -89,6 +89,27 @@ def test_cached_alive_count_matches_recount():
     assert sp.alive_count() == packed_alive_count(sp._packed)
 
 
+def test_pattern_straddling_torus_seam():
+    # A blinker crossing the x=0 seam is 3 cells, not torus-spanning:
+    # the cyclic bounding box must keep it sparse and evolve it exactly.
+    size = 2**20
+    sp = SparseTorus(size, [(size - 1, 10), (0, 10), (1, 10)])
+    sp.run(1)
+    assert set(sp.alive_cells()) == {(0, 9), (0, 10), (0, 11)}
+    sp.run(1)
+    assert set(sp.alive_cells()) == {(size - 1, 10), (0, 10), (1, 10)}
+
+
+def test_cyclic_extent():
+    from gol_tpu.models.sparse import _cyclic_extent
+
+    assert _cyclic_extent([5], 100) == (5, 1)
+    assert _cyclic_extent([3, 4, 5], 100) == (3, 3)
+    assert _cyclic_extent([99, 0, 1], 100) == (99, 3)
+    assert _cyclic_extent([0, 99], 100) == (99, 2)
+    assert _cyclic_extent([0, 50], 100) in {(0, 51), (50, 51)}  # tie
+
+
 def test_rejects_bad_input():
     with pytest.raises(ValueError):
         SparseTorus(1000, [(0, 0)])  # size not a multiple of 32
