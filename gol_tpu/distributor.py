@@ -174,8 +174,15 @@ def distributor(
                     )
                 elif key == "p":
                     engine.cf_put(FLAG_PAUSE)
-                    _, turn = engine.alive_count()
+                    # The flag is committed: toggle local state BEFORE the
+                    # (fallible) turn poll, or a transient failure there
+                    # would leave controller and engine pause-inverted
+                    # for the rest of the run.
                     paused = not paused
+                    try:
+                        _, turn = engine.alive_count()
+                    except (ConnectionError, OSError, RuntimeError):
+                        turn = 0
                     if paused:
                         events_q.put(ev.StateChange(turn, ev.State.PAUSED))
                     else:
@@ -207,8 +214,10 @@ def distributor(
                 alive, turn = engine.alive_count()
             except EngineKilled:
                 return
-            except (ConnectionError, OSError):
-                continue  # engine outage: resume ticking after reattach
+            except (ConnectionError, OSError, RuntimeError):
+                # Outage or a transient wrapped server error: keep the
+                # telemetry thread alive for the rest of the run.
+                continue
             events_q.put(ev.AliveCellsCount(turn, alive))
 
     # -- live view feed: CellsFlipped diffs + TurnComplete ----------------
@@ -251,7 +260,14 @@ def distributor(
             world, start_turn = engine.get_world()
             turns_left = max(p.turns - start_turn, 0)
         else:
-            world = read_pgm(input_path(width, height, images_dir))
+            src = input_path(width, height, images_dir)
+            world = read_pgm(src)
+            if world.shape != (height, width):
+                # A mislabeled file would silently evolve the wrong
+                # geometry under correctly-named outputs — fail here.
+                raise ValueError(
+                    f"{src}: image is {world.shape[1]}x{world.shape[0]} "
+                    f"but Params say {width}x{height}")
             turns_left = p.turns
 
         events_q.put(ev.StateChange(start_turn, ev.State.EXECUTING))
@@ -278,6 +294,12 @@ def distributor(
                 final_world, final_turn = engine.server_distributor(
                     run_params, world, _sub_workers(), start_turn=start_turn
                 )
+                if lost_pending:
+                    # The resubmit itself proved contact (a reattach whose
+                    # get_world still failed): close the Lost episode so
+                    # consumers always see paired Lost/Reattached events.
+                    events_q.put(ev.EngineReattached(final_turn))
+                    lost_pending = False
                 break
             except EngineKilled:
                 final_world, final_turn = world, start_turn

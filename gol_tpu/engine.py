@@ -306,13 +306,17 @@ class Engine:
         self._flags.put(flag)
 
     def drain_flags(self) -> None:
-        """Discard queued control flags. A controller calls this once when
-        it attaches, BEFORE it starts forwarding keypresses, so flags left
-        over from a previous (detached/dead) controller session can't
-        poison the new run, while the new controller's own early flags are
-        honoured (reference analog: the broker's flag channel is emptied by
-        its per-turn sentinel cycle, `Server:136-150`)."""
+        """Discard STALE control flags — those left by a previous
+        (detached/dead) controller session on a PARKED engine. A no-op
+        while a run is in flight: an attaching observer must not be able
+        to wipe the running controller's pause/quit flags out of the
+        queue (flags are not token-scoped the way abort_run is).
+        Reference analog: the broker's flag channel is emptied by its
+        per-turn sentinel cycle, `Server:136-150`."""
         self._check_alive()
+        with self._state_lock:
+            if self._running:
+                return
         while True:
             try:
                 self._flags.get_nowait()
@@ -358,13 +362,15 @@ class Engine:
         size, measured turns/s of the last full chunk, rule, devices.
         Beyond-reference observability (SURVEY §5: the Go system's only
         metric is the alive-count poll)."""
+        from gol_tpu.ops.bitpack import WORD_BITS
+
         self._check_alive()
         with self._state_lock:
             cells = self._cells
             shape = None
             if cells is not None:
                 h, w = cells.shape[-2], cells.shape[-1]
-                shape = [h, w * 32] if self._packed else [h, w]
+                shape = [h, w * WORD_BITS] if self._packed else [h, w]
             return {
                 "turn": self._turn,
                 "running": self._running,
@@ -477,7 +483,7 @@ class Engine:
             return chunk  # partial (remainder) chunk — timing unrepresentative
         self._fixed_cost_est = min(self._fixed_cost_est, elapsed)
         marginal = elapsed - self._fixed_cost_est
-        if marginal < CHUNK_TARGET_SECONDS and chunk < self._max_chunk:
+        if marginal < CHUNK_TARGET_SECONDS and chunk * 2 <= self._max_chunk:
             return chunk * 2
         if marginal > CHUNK_TARGET_SECONDS * 2 and chunk > 1:
             return chunk // 2

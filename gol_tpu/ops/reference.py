@@ -30,6 +30,12 @@ def step_np(board01: np.ndarray) -> np.ndarray:
 
 
 def run_turns_np(board01: np.ndarray, num_turns: int) -> np.ndarray:
+    if board01.size and board01.max() > 1:
+        # Passing the {0,255} PIXEL format here would sum 255s into the
+        # neighbour counts and silently produce an all-dead "golden" —
+        # the oracle must fail loudly, never fabricate fixtures.
+        raise ValueError(
+            f"oracle wants a {{0,1}} board, got max {board01.max()}")
     b = board01.copy()
     for _ in range(num_turns):
         b = step_np(b)

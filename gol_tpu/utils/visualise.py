@@ -19,8 +19,12 @@ _DEAD = " "
 def _to_grid(cells: Iterable[Tuple[int, int]], w: int, h: int) -> np.ndarray:
     grid = np.zeros((h, w), dtype=np.uint8)
     for x, y in cells:
-        if 0 <= x < w and 0 <= y < h:
-            grid[y, x] = 1
+        if not (0 <= x < w and 0 <= y < h):
+            # Silently dropping a stray cell would make board_diff hide
+            # exactly the boundary off-by-ones it exists to expose.
+            raise ValueError(
+                f"cell ({x}, {y}) outside {w}x{h} board")
+        grid[y, x] = 1
     return grid
 
 

@@ -357,6 +357,53 @@ def test_recovery_quits_orphaned_run(images_dir, out_dir, monkeypatch):
     np.testing.assert_array_equal(_alive_board(final, want.shape), want)
 
 
+def test_drain_flags_noop_while_running(monkeypatch):
+    """An attaching observer's drain_flags must not wipe the running
+    controller's control flags; on a parked engine it drains."""
+    from gol_tpu.engine import FLAG_QUIT
+
+    monkeypatch.setenv("GOL_MAX_CHUNK", "4")
+    eng = Engine()
+    world = np.zeros((16, 16), dtype=np.uint8)
+    world[4:7, 5] = 255
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    t = threading.Thread(
+        target=eng.server_distributor, args=(p, world), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    while not eng._running:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    eng.cf_put(FLAG_QUIT)
+    eng.drain_flags()  # no-op: run in flight
+    t.join(30)
+    assert not t.is_alive(), "quit flag was drained by the observer"
+    # Parked engine: stale flags ARE drained.
+    eng.cf_put(FLAG_QUIT)
+    eng.drain_flags()
+    assert eng._flags.empty()
+
+
+def test_max_chunk_cap_respected_for_non_power_of_two(monkeypatch):
+    """GOL_MAX_CHUNK=3 must never produce a 4-turn chunk (the doubling
+    guard used to overshoot non-power-of-two caps by up to 2x)."""
+    monkeypatch.setenv("GOL_MAX_CHUNK", "3")
+    eng = Engine()
+    seen = []
+    orig = eng._adapt_chunk
+
+    def spy(chunk, k, elapsed):
+        seen.append(k)
+        return orig(chunk, k, elapsed)
+
+    eng._adapt_chunk = spy
+    world = np.zeros((16, 16), dtype=np.uint8)
+    world[4:7, 5] = 255
+    p = Params(threads=1, image_width=16, image_height=16, turns=64)
+    eng.server_distributor(p, world)
+    assert seen and max(seen) <= 3
+
+
 def test_abort_run_is_token_scoped(monkeypatch):
     """abort_run must stop only the run submitted with the same token —
     a foreign controller's token is a no-op."""
