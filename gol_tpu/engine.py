@@ -204,7 +204,7 @@ class Engine:
             # Shard-count request: worker-list length (reference SUB),
             # falling back to the `threads` hint (per-worker fan-out).
             requested = len(sub_workers) if sub_workers else params.threads
-            requested = min(requested, len(self._devices))
+            requested = max(1, min(requested, len(self._devices)))
             n_shards = resolve_shard_count(height, requested)
             mesh = make_mesh(n_shards, self._devices)
             cells = shard_board(

@@ -28,12 +28,19 @@ def run(
     live_view: bool = False,
     rule=None,
 ) -> threading.Thread:
+    def _target() -> None:
+        try:
+            distributor(p, events, key_presses, engine, images_dir,
+                        out_dir, live_view, rule)
+        except BaseException as e:
+            # Record for callers that need an exit status (the CLI):
+            # the thread's traceback alone doesn't reach main()'s
+            # return code.
+            t.exception = e
+            raise
+
     t = threading.Thread(
-        target=distributor,
-        args=(p, events, key_presses, engine, images_dir, out_dir,
-              live_view, rule),
-        daemon=True,
-        name="gol-distributor",
-    )
+        target=_target, daemon=True, name="gol-distributor")
+    t.exception = None
     t.start()
     return t

@@ -74,6 +74,10 @@ def _stage_rle_board(name_or_path: str, width: int, height: int):
     for x, y in cells:
         board[oy + y, ox + x] = 255
     d = tempfile.mkdtemp(prefix="gol_rle_")
+    import atexit
+    import shutil
+
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
     write_pgm(input_path(width, height, d), board)
     return d, rle_rule
 
@@ -129,9 +133,15 @@ def main(argv=None) -> int:
                     "run — start the server with --rule to match")
     events_q: "queue.Queue" = queue.Queue(maxsize=10000)
     key_presses: "queue.Queue" = queue.Queue(maxsize=10)
-    run(p, events_q, key_presses, live_view=args.live, rule=rule,
-        images_dir=images_dir)
+    t = run(p, events_q, key_presses, live_view=args.live, rule=rule,
+            images_dir=images_dir)
     view_start(p, events_q, key_presses, headless=args.headless)
+    t.join(30)
+    if t.exception is not None:
+        # The run failed (bad rule, missing image, engine error): the
+        # thread printed its traceback; the CLI must exit non-zero
+        # (reference parity: the Go controller log.Fatal's).
+        return 1
     return 0
 
 

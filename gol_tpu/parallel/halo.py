@@ -250,7 +250,7 @@ def inner_kind(mesh: Mesh, window_shape, T: Optional[int] = None) -> str:
     from gol_tpu.ops.pallas_stencil import banded_supported, fits_in_vmem
 
     platform = mesh.devices.flat[0].platform
-    if platform == "tpu":
+    if platform == "tpu" and window_shape[-1] >= 2:  # wp==1: Mosaic 0-size
         if banded_supported(window_shape) and (
                 T is None or T % 8 == 0 or fits_in_vmem(window_shape)):
             return "banded"
@@ -269,7 +269,11 @@ def packed_run_kind(shape, platform: str) -> str:
     fuses it with the occupancy reduction into one dispatch)."""
     from gol_tpu.ops.pallas_stencil import banded_supported, fits_in_vmem
 
-    if platform == "tpu":
+    if platform == "tpu" and shape[-1] >= 2:
+        # wp == 1 (a 32-cell-wide board) lowers to zero-size vector
+        # slices in the Mosaic kernels ('vector types must have positive
+        # constant sizes') — such boards run the jnp packed path, whose
+        # size-1 rolls are the correct single-word torus wrap.
         if banded_supported(shape):
             return "banded"
         if fits_in_vmem(shape):

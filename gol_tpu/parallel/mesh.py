@@ -31,6 +31,8 @@ def resolve_shard_count(height: int, requested: int) -> int:
     the reduced count and warned about — the reference instead spreads
     remainder rows (`Server:106-116`), so a user coming from it would
     otherwise silently lose parallelism."""
+    if requested < 1:
+        raise ValueError(f"shard request must be >= 1, got {requested}")
     n = max(1, min(requested, height))
     while height % n != 0:
         n -= 1
