@@ -384,21 +384,49 @@ class Engine:
 
     # -------------------------------------------------------- checkpointing
 
-    def save_checkpoint(self, path: str) -> None:
-        """Atomically write (world, turn, rulestring) as a compressed .npz.
+    # Checkpoints at or below this payload size are zlib-compressed;
+    # larger ones are written raw — compressing a 512 MB packed flagship
+    # board would dominate the checkpoint interval for little gain.
+    CKPT_COMPRESS_LIMIT = 64 * 1024 * 1024
 
-        The temp name is per-writer: the SIGTERM handler (main thread) can
-        race the run thread's periodic save on the same target, and a
-        shared '.tmp' would let the two writers interleave and publish a
-        torn file; with unique temps each os.replace publishes a complete
-        checkpoint (last one wins)."""
-        world, turn = self._snapshot()
+    def save_checkpoint(self, path: str) -> None:
+        """Atomically write the board state + turn + rulestring as .npz.
+
+        Packed boards are stored AS PACKED WORDS (`words` + `width`) —
+        8x smaller than pixels and with no device-side unpack, which is
+        what keeps periodic checkpointing viable at 65536² (the pixel
+        route would unpack 512 MB of words into a 4.3 GB array every
+        interval). Unpacked boards store `world` pixels (also the legacy
+        format `load_checkpoint` still accepts).
+
+        The temp name is per-writer: the SIGTERM handler (main thread)
+        can race the run thread's periodic save on the same target, and
+        a shared '.tmp' would let the two writers interleave and publish
+        a torn file; with unique temps each os.replace publishes a
+        complete checkpoint (last one wins)."""
+        with self._state_lock:
+            cells, turn, packed = self._cells, self._turn, self._packed
+        if cells is None:
+            raise RuntimeError("no board loaded")
+        if packed:
+            from gol_tpu.ops.bitpack import WORD_BITS
+
+            arrays = {
+                "words": np.asarray(jax.device_get(cells)),
+                "width": cells.shape[-1] * WORD_BITS,
+            }
+        else:
+            arrays = {"world": np.asarray(
+                jax.device_get(to_pixels(cells)))}
+        payload = arrays.get("words", arrays.get("world"))
+        save = (np.savez_compressed
+                if payload.nbytes <= self.CKPT_COMPRESS_LIMIT
+                else np.savez)
         tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
         try:
             with open(tmp, "wb") as f:
-                np.savez_compressed(
-                    f, world=world, turn=turn,
-                    rulestring=self._rule.rulestring)
+                save(f, turn=turn, rulestring=self._rule.rulestring,
+                     **arrays)
             os.replace(tmp, path)
         finally:
             if os.path.exists(tmp):
@@ -413,7 +441,6 @@ class Engine:
         wrong rule would corrupt the run."""
         self._check_alive()
         with np.load(path) as z:
-            world = z["world"]
             turn = int(z["turn"])
             if "rulestring" in z.files:
                 ckpt_rule = str(z["rulestring"])
@@ -421,10 +448,23 @@ class Engine:
                     raise ValueError(
                         f"checkpoint rule {ckpt_rule!r} != engine rule "
                         f"{self._rule.rulestring!r}")
-        height, width = world.shape
-        packed, _ = select_representation(width)
-        cells01 = from_pixels(world)
-        cells = pack(cells01) if packed else jax.device_put(cells01)
+            if "words" in z.files:
+                # Packed-native checkpoint: no unpack/repack round trip.
+                words = z["words"]
+                width = int(z["width"])
+                packed, _ = select_representation(width)
+                if not packed or words.shape[-1] * 32 != width:
+                    raise ValueError(
+                        f"{path}: inconsistent packed checkpoint "
+                        f"({words.shape} words for width {width})")
+                cells = jax.device_put(words)
+            else:
+                world = z["world"]  # legacy / unpacked pixel format
+                height, width = world.shape
+                packed, _ = select_representation(width)
+                cells01 = from_pixels(world)
+                cells = (pack(cells01) if packed
+                         else jax.device_put(cells01))
         with self._state_lock:
             if self._running:
                 raise RuntimeError("cannot restore while running")

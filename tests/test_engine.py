@@ -204,6 +204,47 @@ def test_checkpoint_roundtrip_autosave_and_resume(tmp_path, monkeypatch):
     np.testing.assert_array_equal((snap != 0).astype(np.uint8), want)
 
 
+def test_packed_checkpoint_format_and_legacy_load(tmp_path):
+    """Packed boards checkpoint as packed words (8x smaller, no unpack);
+    the legacy pixel format still loads; inconsistent packed files are
+    rejected."""
+    import numpy as np
+
+    rng = np.random.default_rng(47)
+    world = ((rng.random((64, 64)) < 0.3).astype(np.uint8)) * 255
+    eng = Engine()
+    p = Params(threads=2, image_width=64, image_height=64, turns=10)
+    out, _ = eng.server_distributor(p, world)
+
+    path = str(tmp_path / "c.npz")
+    eng.save_checkpoint(path)
+    with np.load(path) as z:
+        assert "words" in z.files and int(z["width"]) == 64
+        assert "world" not in z.files
+        assert z["words"].nbytes == 64 * 64 // 8  # 8x below pixels
+
+    fresh = Engine()
+    assert fresh.load_checkpoint(path) == 10
+    got, turn = fresh.get_world()
+    np.testing.assert_array_equal(got, out)
+
+    # Legacy pixel-format checkpoint still restores.
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez(legacy, world=out, turn=10, rulestring="B3/S23")
+    eng2 = Engine()
+    assert eng2.load_checkpoint(legacy) == 10
+    got2, _ = eng2.get_world()
+    np.testing.assert_array_equal(got2, out)
+
+    # Inconsistent packed checkpoint (width disagrees with words).
+    bad = str(tmp_path / "bad.npz")
+    with np.load(path) as z:
+        np.savez(bad, words=z["words"], width=128, turn=10,
+                 rulestring="B3/S23")
+    with pytest.raises(ValueError, match="inconsistent packed"):
+        Engine().load_checkpoint(bad)
+
+
 def test_checkpoint_rule_mismatch_rejected(tmp_path):
     """A checkpoint written under one rule must not silently resume under
     another (ADVICE r1): load into a HighLife engine raises."""
