@@ -4,13 +4,18 @@ without real multi-chip hardware — the counterpart of the reference's
 localhost broker + 4 workers story (SURVEY §4)."""
 
 import os
+import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force EXACTLY 8 virtual devices, replacing any pre-existing count a
+# developer's shell may export — a 2-device ambient value would silently
+# collapse the whole multi-shard sweep while staying green.
+_flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8"
+).strip()
 
 import jax
 
@@ -18,6 +23,8 @@ import jax
 # jax.config.update (which beats the env var); undo it before any backend
 # is initialized so tests run on the virtual 8-device CPU mesh.
 jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"test mesh must have 8 virtual CPU devices, got {jax.devices()}")
 
 import pathlib
 import sys
@@ -27,6 +34,18 @@ if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
 
 import pytest
+
+
+@pytest.fixture(autouse=True)
+def _isolate_gol_env(monkeypatch):
+    """Every test starts with a clean framework environment: ambient
+    GOL_* / SER / SUB / CONT from a developer's shell (benchmarking
+    leftovers like GOL_MAX_CHUNK or GOL_MESH) would silently reroute
+    engines and defeat throttles while every test stays green. Tests
+    that need a variable set it explicitly via monkeypatch."""
+    for k in list(os.environ):
+        if k.startswith("GOL_") or k in ("SER", "SUB", "CONT"):
+            monkeypatch.delenv(k, raising=False)
 
 
 @pytest.fixture

@@ -51,6 +51,9 @@ def _clean_env(monkeypatch):
 
 
 def test_snapshot_keypress(images_dir, out_dir, monkeypatch):
+    # Throttle: an unthrottled warm-cache free-run can reach 10^5+ turns
+    # in the sleep below, making the numpy-oracle replay take minutes.
+    monkeypatch.setenv("GOL_MAX_CHUNK", "8")
     p = Params(threads=1, image_width=64, image_height=64, turns=10**8)
     events_q, keys = queue.Queue(), queue.Queue()
     run(p, events_q, keys, engine=Engine(),
@@ -92,7 +95,8 @@ def test_pause_resume(images_dir, out_dir):
     assert any(isinstance(x, ev.FinalTurnComplete) for x in evs)
 
 
-def test_pause_actually_stops_turns(images_dir, out_dir):
+def test_pause_actually_stops_turns(images_dir, out_dir, monkeypatch):
+    monkeypatch.setenv("GOL_MAX_CHUNK", "8")  # fast flag response
     engine = Engine()
     p = Params(threads=1, image_width=64, image_height=64, turns=10**8)
     events_q, keys = queue.Queue(), queue.Queue()
@@ -100,8 +104,17 @@ def test_pause_actually_stops_turns(images_dir, out_dir):
         images_dir=images_dir, out_dir=out_dir)
     time.sleep(1.0)
     keys.put("p")
-    time.sleep(1.0)  # engine parks between chunks
+    # The pause lands at the next chunk boundary; a first-chunk compile
+    # can outlast any fixed sleep, so wait for quiescence (two equal
+    # reads) before asserting the turn stays put.
+    deadline = time.monotonic() + 60
     _, t1 = engine.alive_count()
+    while time.monotonic() < deadline:
+        time.sleep(0.5)
+        _, t = engine.alive_count()
+        if t == t1:
+            break
+        t1 = t
     time.sleep(1.5)
     _, t2 = engine.alive_count()
     assert t1 == t2, f"turn advanced while paused: {t1} -> {t2}"

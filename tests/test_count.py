@@ -43,11 +43,15 @@ def test_alive_telemetry(images_dir, check_dir, out_dir, monkeypatch):
         if isinstance(e, ev.AliveCellsCount):
             if first_at is None:
                 first_at = time.monotonic() - start
+            if e.completed_turns == 0 and e.cells_count == 0:
+                # Pre-board-load tick (reference parity: the broker's
+                # Alivecount answers 0 before a run starts) — counts it
+                # for the latency bound but not for CSV parity.
+                continue
             counts.append(e)
     # first event within 5 s (`count_test.go:29-35`)
     assert first_at is not None and first_at <= 5.0, first_at
     assert len(counts) >= 5
-    verified = 0
     for e in counts:
         if e.completed_turns <= 10_000:
             assert golden[e.completed_turns] == e.cells_count, (
@@ -63,8 +67,6 @@ def test_alive_telemetry(images_dir, check_dir, out_dir, monkeypatch):
             assert e.cells_count == want, (
                 f"turn {e.completed_turns}: got {e.cells_count}, "
                 f"want oscillating {want}")
-        verified += 1
-    assert verified >= 1, "no tick verified"
     # quit the unbounded run (`q` keypress, flag 2) and drain to CLOSE.
     keys.put("q")
     while True:
