@@ -54,3 +54,8 @@ def test_detach_resume(repo_root, tmp_path):
     out = run_example(repo_root, tmp_path, "detach_resume.py")
     assert "detached at turn" in out
     assert "resumed and finished" in out
+
+
+def test_brians_brain(repo_root, tmp_path):
+    out = run_example(repo_root, tmp_path, "brians_brain.py", ["200"])
+    assert "cells firing" in out and "packed bit-plane" in out
