@@ -108,6 +108,13 @@ def main(argv=None) -> int:
         from gol_tpu.models.lifelike import LifeLikeRule
 
         rule = LifeLikeRule(args.rule)  # fail fast on a malformed string
+        if os.environ.get("SER"):
+            import warnings
+
+            warnings.warn(
+                f"--rule {rule.rulestring} has no effect with SER set: "
+                "the REMOTE engine's own rule governs the run — start "
+                "the server with --rule to match")
     p = Params(
         threads=args.threads,
         image_width=args.width,

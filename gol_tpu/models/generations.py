@@ -203,4 +203,8 @@ class GenerationsTorus:
             from gol_tpu.ops.bitpack import packed_alive_count
 
             return packed_alive_count(self._a)
-        return int(jnp.sum(self._state == 1))
+        # Per-row int32 sums, final sum in host int64 — a flat int32
+        # reduction would wrap past 2^31 firing cells on giant boards.
+        rows = jnp.sum((self._state == 1).astype(jnp.int32), axis=-1)
+        return int(np.asarray(jax.device_get(rows),
+                              dtype=np.int64).sum())

@@ -4,8 +4,10 @@ BASELINE config 5 is "R-pentomino on a 2^20 sparse torus" — a board of
 2^40 cells (137 GB packed), absurd to materialise when fewer than a few
 thousand cells are ever alive. This engine tracks only the live bounding
 window as a packed board on-device and advances it with the same kernel
-dispatch as the dense engine (`parallel/halo.py:_single_device_packed_run`
-— VMEM pallas kernel, banded kernel, or jnp scan as the window grows).
+tiers as the dense engine (`parallel/halo.py:packed_run_kind` — VMEM
+pallas kernel, banded kernel, or jnp scan as the window grows), fused
+with the occupancy reduction into one dispatch per macro-step
+(`_fused_run`).
 
 Correctness argument: the window is stepped with ordinary *torus* stepping.
 As long as every live cell stays at least one row/column inside the window
@@ -161,9 +163,6 @@ class SparseTorus:
         # (row, col-word) popcount occupancy of `_packed`, as device
         # arrays — refreshed for free by every fused macro-step.
         self._occ = None
-        # Margins known analytically right after a `_grow` (no device
-        # round trip); invalidated by every step.
-        self._grown_margins = None
 
     # ------------------------------------------------------------- queries
 
@@ -241,13 +240,6 @@ class SparseTorus:
             % self.size
         self._oy = (self._oy + top - pad_top) % self.size
         self._packed = new
-        # The live extent is unchanged, so the new margins are exactly the
-        # paddings — no device round trip needed to re-measure.
-        pad_left = pad_left_words * WORD_BITS
-        self._grown_margins = (
-            pad_top, new_h - live_h - pad_top,
-            pad_left, new_w - live_w - pad_left,
-        )
         self._occ = None
 
     # ------------------------------------------------------------- stepping
@@ -262,9 +254,7 @@ class SparseTorus:
         a dispatch and larger windows cost compute, so spare margin is
         spent before the window is regrown)."""
         target = min(remaining, cap)
-        m = self._grown_margins
-        if m is None:
-            m = self._margins()
+        m = self._margins()
         if m is None:
             return -1  # pattern died out
         # A dimension capped at the full torus needs no margin at all —
@@ -313,6 +303,5 @@ class SparseTorus:
             run = _fused_run(self._packed.shape, k, self.rule, kind)
             self._packed, rows, cols = run(self._packed)
             self._occ = (rows, cols)
-            self._grown_margins = None
             done += k
             self.turn += k

@@ -23,14 +23,21 @@ import numpy as np
 
 _LEN = struct.Struct(">I")
 MAX_HEADER = 1 << 20
-# Upper bound on h*w accepted from a peer before allocating: 2^32 cells
-# (4 GiB, exactly the 65536² flagship board) — a hostile or garbage
-# header must not be able to trigger an arbitrary-size allocation. The
-# reference trusts gob inside a VPC; a hand-rolled TCP plane bounds its
-# inputs. Hosts serving larger boards raise it via GOL_MAX_BOARD_CELLS.
+# Upper bound on h*w accepted from a peer before allocating: 2^35 cells
+# covers the largest board the framework demonstrates (131072² = 2^34)
+# with one doubling of headroom — a hostile or garbage header must not
+# be able to trigger an arbitrary-size allocation. The reference trusts
+# gob inside a VPC; a hand-rolled TCP plane bounds its inputs. Settable
+# at RUNTIME via GOL_MAX_BOARD_CELLS (read per message, not frozen at
+# import, so server processes can be reconfigured the same way SER/CONT
+# are).
 from gol_tpu.utils.envcfg import env_int
 
-MAX_BOARD_CELLS = env_int("GOL_MAX_BOARD_CELLS", 1 << 32)
+DEFAULT_MAX_BOARD_CELLS = 1 << 35
+
+
+def max_board_cells() -> int:
+    return env_int("GOL_MAX_BOARD_CELLS", DEFAULT_MAX_BOARD_CELLS)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -81,7 +88,7 @@ def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
             w = int(header["world"]["w"])
         except (TypeError, KeyError, ValueError) as e:
             raise ConnectionError(f"malformed world dims: {e}") from e
-        if h <= 0 or w <= 0 or h * w > MAX_BOARD_CELLS:
+        if h <= 0 or w <= 0 or h * w > max_board_cells():
             raise ConnectionError(f"board dims out of bounds: {h}x{w}")
         # Receive straight into the final array — going through bytes
         # would peak at ~3x the payload for a multi-GB snapshot.

@@ -125,7 +125,7 @@ def test_hostile_world_dims_rejected(server):
 
     import json as _json
 
-    from gol_tpu.wire import MAX_BOARD_CELLS, recv_msg
+    from gol_tpu.wire import max_board_cells, recv_msg
 
     s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
     hdr = _json.dumps(
@@ -141,7 +141,8 @@ def test_hostile_world_dims_rejected(server):
     # server is still alive for well-formed clients
     eng = RemoteEngine(f"127.0.0.1:{server.port}")
     assert eng.alive_count()[1] >= 0
-    assert 2**31 * 2**31 > MAX_BOARD_CELLS
+    assert 2**31 * 2**31 > max_board_cells()
+    assert 131072 * 131072 <= max_board_cells()  # demonstrated board fits
 
 
 def test_recv_msg_bounds_unit():
