@@ -32,7 +32,7 @@ from gol_tpu.engine import (
 from gol_tpu.io.pgm import input_path, output_path, read_pgm, write_pgm
 from gol_tpu.params import Params
 from gol_tpu.utils.cell import alive_cells_from_board
-from gol_tpu.utils.envcfg import env_float
+from gol_tpu.utils.envcfg import env_float, env_int
 
 ALIVE_POLL_SECONDS = 2.0  # reference ticker (`Local/gol/distributor.go:58`)
 
@@ -374,12 +374,20 @@ def distributor(
                 lost_pending = False
 
         # -- finalize (`:187-226`) ----------------------------------------
-        alive_cells = alive_cells_from_board(final_world)
-        events_q.put(
-            ev.FinalTurnComplete(
-                final_turn, tuple((c.x, c.y) for c in alive_cells)
-            )
-        )
+        # Reference contract: the final event carries the alive-cell set
+        # (`Local/gol_test.go:32-37`). Beyond GOL_MAX_EVENT_CELLS total
+        # cells, only the count travels — a 65536² board's ~10^9
+        # coordinate tuples would exhaust controller memory.
+        max_event_cells = env_int(
+            "GOL_MAX_EVENT_CELLS", 1 << 24, minimum=0)
+        if final_world.size <= max_event_cells:
+            alive_cells = alive_cells_from_board(final_world)
+            alive = tuple((c.x, c.y) for c in alive_cells)
+            count = len(alive)
+        else:
+            alive = ()
+            count = int((final_world != 0).sum())
+        events_q.put(ev.FinalTurnComplete(final_turn, alive, count))
         fname = output_path(width, height, final_turn, out_dir)
         write_pgm(fname, final_world)
         events_q.put(

@@ -92,9 +92,19 @@ class TurnComplete(Event):
 @dataclasses.dataclass(frozen=True)
 class FinalTurnComplete(Event):
     """Terminal event carrying the alive-cell set; the test-harness hook
-    (`event.go:112-124`, consumed at `Local/gol_test.go:32-37`)."""
+    (`event.go:112-124`, consumed at `Local/gol_test.go:32-37`).
+
+    `alive_count` is always populated. For boards beyond
+    GOL_MAX_EVENT_CELLS total cells (default 2^24) the `alive` tuple is
+    left EMPTY — materialising ~10^9 coordinate tuples for a 65536²
+    board would exhaust controller memory; at every reference scale the
+    full list is present and `alive_count == len(alive)`."""
 
     alive: Tuple[Tuple[int, int], ...] = ()  # (x, y) pairs
+    alive_count: int = -1  # -1 only for hand-built legacy instances
+
+    def count(self) -> int:
+        return self.alive_count if self.alive_count >= 0 else len(self.alive)
 
 
 @dataclasses.dataclass(frozen=True)

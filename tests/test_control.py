@@ -126,6 +126,28 @@ def test_pause_actually_stops_turns(images_dir, out_dir, monkeypatch):
     _drain_to_close(events_q)
 
 
+def test_final_event_cell_list_capped_for_giant_boards(
+    images_dir, out_dir, monkeypatch
+):
+    """Beyond GOL_MAX_EVENT_CELLS the final event carries only the
+    count — materialising ~1e9 coordinate tuples for a flagship board
+    would OOM the controller. At reference scales (default threshold)
+    the full list is present."""
+    monkeypatch.setenv("GOL_MAX_EVENT_CELLS", "1000")  # force the cap
+    p = Params(threads=1, image_width=64, image_height=64, turns=3)
+    events_q = queue.Queue()
+    run(p, events_q, None, engine=Engine(),
+        images_dir=images_dir, out_dir=out_dir)
+    evs = _drain_to_close(events_q)
+    fin = [e for e in evs if isinstance(e, ev.FinalTurnComplete)][0]
+    assert fin.alive == ()
+    want = run_turns_np(
+        (read_pgm(os.path.join(images_dir, "64x64.pgm")) != 0
+         ).astype(np.uint8), 3)
+    assert fin.alive_count == int(want.sum())
+    assert fin.count() == fin.alive_count
+
+
 def test_detach_and_resume_matches_uninterrupted(
     images_dir, out_dir, monkeypatch
 ):
