@@ -15,15 +15,18 @@ that bounds it to ~100 turns/s on 512², i.e. ~2.6e7 cell-updates/s. We use
 BASELINE_CUPS = 2.6e7; `vs_baseline` = measured / baseline (512² only —
 the estimate is board-specific).
 
-Turn-count methodology (r2 profile finding): on the axon TPU tunnel each
-dispatched program costs ~110 ms of FIXED host↔device round-trip latency,
-while the 512² VMEM kernel's marginal cost is ~0.2 µs/turn (measured by
-large-K deltas: K=1024 vs K=65536 differ by ~13 ms, not 64×). Round 1
-benched 2000 turns per call and so measured the tunnel, not the kernel
-(2.8e9 "cups" = 110 ms / 2000 turns). Default turn counts below are sized
-so device compute dominates the fixed latency ≥10×; the reference's own
-default run length is 10¹⁰ turns (`Local/main.go:37`), so large K is the
-honest workload, not a trick.
+Turn-count methodology (r2 profile finding, re-measured r3): on the axon
+TPU tunnel each dispatched program costs a FIXED ~0.16-0.18 s of
+host↔device round trip regardless of board size, while the marginal
+per-turn cost is tiny (two-point K-sweeps on the real chip, r3: 512²
+0.162 µs/turn, 5120² 11.1 µs/turn, 65536² 1.70 ms/turn). Round 1 benched
+2000 turns per call and so measured the tunnel, not the kernel (its 2.8e9
+"cups" is just the fixed round trip divided by 2000 turns — 512² × 2000 /
+2.8e9 ≈ 0.19 s, the same fixed cost re-measured here). Default turn
+counts below are sized so device compute dominates the fixed latency ≥10×
+(≈2 s of device time per timed call); the reference's own default run
+length is 10¹⁰ turns (`Local/main.go:37`), so large K is the honest
+workload, not a trick.
 
 Usage:
     python bench.py                # full matrix: 5120², 65536², sparse,
@@ -43,19 +46,24 @@ import numpy as np
 
 BASELINE_CUPS = 2.6e7  # see module docstring
 
-# Per-config default turns: device compute ≈ 10x the ~110 ms fixed
-# dispatch latency (512² at 0.2 µs/turn, 5120² at ~0.42 ms/turn, 65536²
-# at ~5.9 ms/turn measured r1/r2).
-DEFAULT_TURNS = {512: 2_000_000, 5120: 8_000, 65536: 512}
+# Per-config default turns: device compute ≈ 10x the ~0.17 s fixed
+# dispatch latency, using the r3-measured marginal per-turn costs
+# (512² 0.162 µs, 5120² 11.1 µs, 65536² 1.70 ms — see module docstring).
+# The 65536² count stays a multiple of BAND_T=32 so the banded kernel
+# never needs a remainder pass.
+DEFAULT_TURNS = {512: 12_000_000, 5120: 160_000, 65536: 1536}
 SPARSE_TURNS = 8_192
 
 
 def default_turns(n: int) -> int:
-    """Turn count for an ad-hoc --size: target ~1 s of device compute at
-    an assumed ~1e12 cups so the fixed dispatch latency stays <10% (same
-    sizing rule as the explicit DEFAULT_TURNS entries)."""
-    return DEFAULT_TURNS.get(
-        n, max(256, min(2_000_000, int(1e12) // (n * n))))
+    """Turn count for an ad-hoc --size: target ~2 s of device compute at
+    an assumed ~2e12 cups so the fixed dispatch latency stays <10% (same
+    sizing rule as the explicit DEFAULT_TURNS entries). Rounded down to a
+    multiple of 32 so giant boards stay on whole banded sweeps."""
+    if n in DEFAULT_TURNS:
+        return DEFAULT_TURNS[n]
+    t = max(256, min(16_000_000, int(4e12) // (n * n)))
+    return max(256, t - t % 32)
 
 
 def _emit(metric, value, unit, vs_baseline, detail):

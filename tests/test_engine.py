@@ -309,3 +309,37 @@ def test_gol_mesh_nonpositive_dims_fall_back(monkeypatch):
         with pytest.warns(UserWarning, match="GOL_MESH"):
             eng = Engine()
         assert eng._mesh_shape is None
+
+
+@pytest.mark.parametrize(
+    "h,w,turns,shards",
+    [
+        (48, 96, 50, 4),   # wide, packed tier (w % 32 == 0)
+        (96, 48, 50, 4),   # tall
+        (40, 33, 17, 2),   # odd width, uint8 roll-sum tier
+        (17, 64, 9, 3),    # prime height -> shard-downgrade path
+    ],
+)
+def test_non_square_boards(h, w, turns, shards, recwarn):
+    """Rectangular boards evolve bit-exactly through the full engine path
+    (packed and uint8 tiers; rectangular pallas shapes are pinned in
+    tests/test_pallas.py).
+
+    The reference silently assumes square boards (multiple loops bound x
+    by ImageHeight, `Local/gol/distributor.go:80,140,207`); this framework
+    consciously fixes that quirk, so pin H != W through the full engine
+    path against the oracle."""
+    eng = Engine()
+    w0 = board(h, w, seed=h * 1000 + w)
+    p = Params(threads=4, image_width=w, image_height=h, turns=turns)
+    subs = [f"fake:{8030 + i}" for i in range(shards)]
+    out, turn = eng.server_distributor(p, w0, sub_workers=subs)
+    assert turn == turns
+    want = run_turns_np((w0 != 0).astype(np.uint8), turns)
+    np.testing.assert_array_equal((out != 0).astype(np.uint8), want)
+    downgrades = [wn for wn in recwarn.list
+                  if "downgraded" in str(wn.message)]
+    if h % shards:  # prime-height case: pin the downgrade warning
+        assert downgrades, "expected a shard-downgrade warning"
+    else:
+        assert not downgrades
