@@ -290,6 +290,13 @@ def main() -> int:
                     help="'dense' (default) or a sparse-torus pattern "
                          "(rpentomino = BASELINE config 5)")
     args = ap.parse_args()
+    # Same entry-point cache policy as the CLI/server: the bench compiles
+    # ~a dozen distinct programs per matrix run (timed lengths, warmups,
+    # parity replays, the sparse ladder); the persistent cache turns
+    # repeat runs from minutes of compile into seconds.
+    import gol_tpu
+
+    gol_tpu.maybe_enable_default_compile_cache()
 
     if args.pattern != "dense":
         if args.size is not None:

@@ -51,6 +51,25 @@ def default_compile_cache_dir() -> str:
         "gol_tpu", "xla")
 
 
+def maybe_enable_default_compile_cache() -> bool:
+    """Entry-point policy, shared by the CLI, server, and bench: default
+    the persistent XLA compile cache on for accelerator backends (restart-
+    heavy processes should not repay the chunk-ramp compiles). Explicit
+    GOL_COMPILE_CACHE wins (the import-time block below handles non-empty
+    values; empty string disables). CPU is excluded — XLA:CPU's AOT cache
+    embeds exact machine features and reloads can SIGILL/wedge ("Machine
+    type used for compilation doesn't match execution"). Returns whether
+    the cache was enabled here."""
+    if "GOL_COMPILE_CACHE" in _os.environ:
+        return False
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return False
+    enable_compile_cache(default_compile_cache_dir())
+    return True
+
+
 if _os.environ.get("GOL_COMPILE_CACHE"):
     # Opt-in at import time via env; the CLI entry points additionally
     # default-enable the cache (see main.py / server.py) — set
@@ -90,5 +109,6 @@ __all__ = [
     "StateChange",
     "TurnComplete",
     "enable_compile_cache",
+    "maybe_enable_default_compile_cache",
     "default_compile_cache_dir",
 ]

@@ -88,17 +88,7 @@ def main(argv=None) -> int:
 
     import gol_tpu
 
-    if "GOL_COMPILE_CACHE" not in os.environ:
-        # CLI runs are restart-heavy: default the persistent XLA compile
-        # cache on for accelerator backends (library imports stay opt-in;
-        # GOL_COMPILE_CACHE="" disables). CPU is excluded: XLA:CPU's AOT
-        # cache embeds exact machine features and reloads can SIGILL/wedge
-        # ("Machine type used for compilation doesn't match execution").
-        import jax
-
-        if jax.default_backend() != "cpu":
-            gol_tpu.enable_compile_cache(
-                gol_tpu.default_compile_cache_dir())
+    gol_tpu.maybe_enable_default_compile_cache()
     if args.trace:
         from gol_tpu.engine import TRACE_ENV
 

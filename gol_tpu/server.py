@@ -155,18 +155,9 @@ def main() -> None:
 
         print(f"multi-host engine: process {jax.process_index()}/"
               f"{jax.process_count()}, {len(jax.devices())} device(s)")
-    if "GOL_COMPILE_CACHE" not in os.environ:
-        # Server restarts (checkpoint resume, failover) should not repay
-        # the chunk-ramp compiles; GOL_COMPILE_CACHE="" disables. CPU is
-        # excluded — XLA:CPU's AOT cache embeds exact machine features
-        # and reloads can SIGILL/wedge.
-        import jax
+    import gol_tpu
 
-        if jax.default_backend() != "cpu":
-            import gol_tpu
-
-            gol_tpu.enable_compile_cache(
-                gol_tpu.default_compile_cache_dir())
+    gol_tpu.maybe_enable_default_compile_cache()
     from gol_tpu.models.lifelike import LifeLikeRule
 
     srv = EngineServer(port=args.port, host=args.host,
