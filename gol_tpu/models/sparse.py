@@ -51,9 +51,13 @@ _WIDE_COL_ALIGN = 4096   # beyond VMEM: 128-lane word alignment for banded
 _GROW_NUM, _GROW_DEN = 3, 2   # headroom = need * 3/2 + 64
 
 # Macro-step sizing. Each macro-step is ONE device dispatch (the turn loop
-# and the occupancy reduction are fused into a single XLA program), so on a
-# remote/tunneled TPU the per-dispatch round trip (~100 ms measured) is the
-# dominant cost and macros should be as deep as the window margin allows.
+# and the occupancy reduction are fused into a single XLA program). On a
+# remote/tunneled TPU the per-ROUND-TRIP cost (~0.17 s measured) dominates,
+# but consecutive dispatches pipeline (measured r3: 8 chained dispatches
+# complete in ~1.1 round trips), so `run()` batches macro-steps into
+# synchronization-free EPISODES: one margins fetch buys `margin - 1` turns
+# of provably safe stepping (a pattern expands ≤ 1 cell/turn), which is
+# issued as a chain of async macros with no host sync between them.
 # Macro depths are quantized to powers of two in [_MACRO_MIN, cap] so the
 # set of (window shape, depth) compilations stays small and warmable.
 _MACRO_CAP = 2048   # sweep on the real chip: 2048 beats 1024/4096
@@ -163,6 +167,12 @@ class SparseTorus:
         # (row, col-word) popcount occupancy of `_packed`, as device
         # arrays — refreshed for free by every fused macro-step.
         self._occ = None
+        # Host-side margins cache: fetching `_occ` is a full tunnel round
+        # trip, so `_margins()` memoizes its result until the board
+        # changes, and `_grow()` — which repositions a known live box —
+        # fills it analytically without touching the device.
+        self._margins_host: Optional[Tuple[int, int, int, int]] = None
+        self._margins_valid = False
 
     # ------------------------------------------------------------- queries
 
@@ -190,21 +200,32 @@ class SparseTorus:
 
     def _margins(self) -> Optional[Tuple[int, int, int, int]]:
         """(top, bottom, left, right) dead margins of the window, with
-        column granularity of one 32-bit word; None when no cell lives."""
+        column granularity of one 32-bit word; None when no cell lives.
+
+        Memoized on the host until the board changes (`_margins_valid`):
+        the device fetch is a full tunnel round trip, and `run()`'s
+        episode batching depends on paying it once per episode, not once
+        per macro-step."""
+        if self._margins_valid:
+            return self._margins_host
         if self._occ is None:
             self._occ = _occupancy(self._packed)
         rows, cols = (np.asarray(a) for a in jax.device_get(self._occ))
         live_rows = np.nonzero(rows)[0]
         live_cols = np.nonzero(cols)[0]
         if live_rows.size == 0:
-            return None
-        top = int(live_rows[0])
-        bottom = int(self._packed.shape[0] - 1 - live_rows[-1])
-        left = int(live_cols[0]) * WORD_BITS
-        right = (
-            int(self._packed.shape[1] - 1 - live_cols[-1]) * WORD_BITS
-        )
-        return top, bottom, left, right
+            result = None
+        else:
+            top = int(live_rows[0])
+            bottom = int(self._packed.shape[0] - 1 - live_rows[-1])
+            left = int(live_cols[0]) * WORD_BITS
+            right = (
+                int(self._packed.shape[1] - 1 - live_cols[-1]) * WORD_BITS
+            )
+            result = (top, bottom, left, right)
+        self._margins_host = result
+        self._margins_valid = True
+        return result
 
     def _grow(self, need: int) -> None:
         """Re-center the live region in a window with ≥ `need` margin on
@@ -241,67 +262,89 @@ class SparseTorus:
         self._oy = (self._oy + top - pad_top) % self.size
         self._packed = new
         self._occ = None
+        # The grow only repositioned a live box whose bounds we already
+        # hold, so the new margins are known exactly without a device
+        # fetch — this is what lets a grow chain asynchronously into the
+        # episode's macro-steps.
+        pad_left = pad_left_words * WORD_BITS
+        self._margins_host = (
+            pad_top, new_h - pad_top - live_h,
+            pad_left, new_w - pad_left - live_w,
+        )
+        self._margins_valid = True
 
     # ------------------------------------------------------------- stepping
 
-    def _pick_macro(self, remaining: int, cap: int) -> int:
-        """Macro depth for the next fused dispatch, growing the window
-        first when its margin cannot cover a worthwhile depth.
-
-        Safety invariant (module docstring): a k-turn macro needs a dead
-        margin ≥ k + 1 on every side beforehand. Within that, prefer the
-        deepest quantized depth the CURRENT margin allows (each grow costs
-        a dispatch and larger windows cost compute, so spare margin is
-        spent before the window is regrown)."""
-        target = min(remaining, cap)
+    def _safe_budget(self, remaining: int) -> Optional[int]:
+        """Turns provably safe to run WITHOUT re-measuring occupancy:
+        min(relevant margins) - 1 (a pattern expands ≤ 1 cell/turn, so
+        after k chained turns every margin is still ≥ margin₀ - k).
+        None when the pattern died out; `remaining` when every axis is
+        saturated at the full torus (window wrap IS the torus wrap —
+        checked before the margins fetch, so a saturated window never
+        pays a device sync or a died-out check: empty or not, plain
+        torus stepping is exact)."""
+        h, wp = self._packed.shape
+        relevant_axes = []
+        if h < self.size:
+            relevant_axes += [0, 1]
+        if wp * WORD_BITS < self.size:
+            relevant_axes += [2, 3]
+        if not relevant_axes:
+            return remaining
         m = self._margins()
         if m is None:
-            return -1  # pattern died out
-        # A dimension capped at the full torus needs no margin at all —
-        # its window wrap IS the real torus wrap. Excluding it stops a
-        # saturated axis's zero margin from forcing a (futile) grow
-        # before every macro-step.
-        h, wp = self._packed.shape
-        relevant = []
-        if h < self.size:
-            relevant += [m[0], m[1]]
-        if wp * WORD_BITS < self.size:
-            relevant += [m[2], m[3]]
-        if not relevant:
-            return target  # fully saturated: plain torus stepping
-        mm = min(relevant)
-        if target <= mm - 1:
-            return target
-        k = _ladder_floor(mm - 1)  # < target here, since target > mm - 1
-        if k >= min(target, _MACRO_MIN):
-            return k
-        k = target if target < _MACRO_MIN else _ladder_floor(target)
-        self._grow(k + 1)
-        return k
+            return None
+        return min(m[a] for a in relevant_axes) - 1
+
+    def _issue_macro(self, k: int) -> None:
+        """Dispatch one fused k-turn macro-step asynchronously."""
+        from gol_tpu.parallel.halo import packed_run_kind
+
+        platform = next(iter(self._packed.devices())).platform
+        kind = packed_run_kind(self._packed.shape, platform)
+        run = _fused_run(self._packed.shape, k, self.rule, kind)
+        self._packed, rows, cols = run(self._packed)
+        self._occ = (rows, cols)
+        self._margins_valid = False
+        self.turn += k
 
     def run(self, turns: int, macro: Optional[int] = None) -> None:
         """Advance `turns` turns in adaptively-sized macro-steps of
-        ≤ `macro` (default `_MACRO_CAP`) turns each."""
-        from gol_tpu.parallel.halo import packed_run_kind
+        ≤ `macro` (default `_MACRO_CAP`) turns each.
 
+        Macro-steps are issued in synchronization-free EPISODES: one
+        margins measurement (a tunnel round trip) establishes a safe turn
+        budget, which is spent as a chain of async dispatches — a window
+        grow (whose post-grow margins are known analytically) followed by
+        ladder-quantized macros — that the device pipeline overlaps. The
+        host only blocks again at the next episode's measurement."""
         cap = macro if macro else _MACRO_CAP
         done = 0
         while done < turns:
-            h, wp = self._packed.shape
-            full_torus = h >= self.size and wp * WORD_BITS >= self.size
-            if full_torus:
-                k = min(cap, turns - done)
-            else:
-                k = self._pick_macro(turns - done, cap)
-                if k < 0:
-                    # Pattern died out: with no B0 birth (guarded in
-                    # __init__) an empty board stays empty forever.
-                    self.turn += turns - done
-                    return
-            platform = next(iter(self._packed.devices())).platform
-            kind = packed_run_kind(self._packed.shape, platform)
-            run = _fused_run(self._packed.shape, k, self.rule, kind)
-            self._packed, rows, cols = run(self._packed)
-            self._occ = (rows, cols)
-            done += k
-            self.turn += k
+            remaining = turns - done
+            budget = self._safe_budget(remaining)
+            if budget is None:
+                # Pattern died out: with no B0 birth (guarded in
+                # __init__) an empty board stays empty forever.
+                self.turn += remaining
+                return
+            target = min(remaining, cap)
+            if budget < min(target, _MACRO_MIN):
+                # Margin can't cover a worthwhile macro: grow for the
+                # deepest quantized depth (async; margins then known).
+                k = target if target < _MACRO_MIN else _ladder_floor(
+                    target)
+                self._grow(k + 1)
+                budget = self._safe_budget(remaining)
+                assert budget is not None
+            # Spend the whole measured budget without further syncs.
+            while done < turns and budget > 0:
+                k = min(turns - done, cap)
+                if k > budget:
+                    k = _ladder_floor(budget)
+                    if k == 0:
+                        break  # leftover < _MACRO_MIN: re-measure
+                self._issue_macro(k)
+                done += k
+                budget -= k
