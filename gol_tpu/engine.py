@@ -39,6 +39,7 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -71,6 +72,22 @@ MAX_CHUNK = 1 << 20
 # slow enough to kill mid-run deterministically.
 MAX_CHUNK_ENV = "GOL_MAX_CHUNK"
 
+# Dispatch pipeline: consecutive compiled chunks are issued asynchronously,
+# up to this many in flight, because the dominant per-dispatch cost is a
+# fixed host↔device round trip that overlaps with device compute (measured
+# r3 on the tunnel: 8 chained dispatches complete in ~1.1 round trips,
+# 0.023 s vs 0.170 s per dispatch). Control semantics survive: flags are
+# still drained between issues (a pause stops the turn counter at once —
+# in-flight chunks merely finish in the background), and every query path
+# (alive_count, get_world, snapshot, checkpoint) reads the newest handle
+# and blocks until it is real, so (alive, turn) pairs stay exact-at-turn.
+# The costs are worst-case control/query latency of ~depth × chunk wall
+# and up to depth + 1 board generations live in HBM (the per-run depth is
+# clamped so those generations fit a fixed byte budget).
+PIPELINE_DEPTH = 3
+PIPELINE_DEPTH_ENV = "GOL_PIPELINE_DEPTH"  # 1 disables (sync per chunk)
+PIPELINE_BOARD_BUDGET = 8 << 30
+
 # GOL_TRACE=<dir>: dump one jax.profiler trace of a representative chunk
 # per run — the counterpart of the reference's runtime/trace TestTrace
 # artifact (`Local/trace_test.go:19-27`, SURVEY §5).
@@ -99,13 +116,14 @@ class EngineBusy(RuntimeError):
 
 
 def _next_chunk(chunk: int, remaining: int) -> int:
-    """Largest power of two ≤ min(chunk, remaining). Keeping every compiled
-    loop length a power of two bounds the set of distinct XLA programs per
-    mesh at O(log MAX_CHUNK)."""
-    k = chunk
-    while k > remaining:
-        k //= 2
-    return max(k, 1)
+    """min(chunk, remaining): full chunks are powers of two/four (so the
+    set of distinct XLA programs per mesh stays O(log MAX_CHUNK) for the
+    sustained phase), and the final remainder runs as ONE exact-length
+    chunk. Decomposing the remainder into its power-of-two set bits would
+    dispatch up to ~10 sizes the ×4 ramp never compiled — each a ~1 s
+    synchronous compile stall right at the end of the run, where a
+    controller is waiting — versus a single one-off compile here."""
+    return max(min(chunk, remaining), 1)
 
 
 class Engine:
@@ -157,6 +175,9 @@ class Engine:
         # Dispatch-floor estimate for the chunk adapter (min elapsed ever
         # observed for a full chunk); engine-lifetime, it only sharpens.
         self._fixed_cost_est = float("inf")
+        # Sliding (pop time, turns) window for the pipelined-regime
+        # adapter; see _adapt_chunk_windowed.
+        self._pace_window: deque = deque(maxlen=8)
         self._max_chunk = MAX_CHUNK
         # Rolling throughput telemetry for the Stats RPC.
         self._last_chunk = 0
@@ -232,6 +253,88 @@ class Engine:
             ckpt_path = os.path.join(ckpt_dir, f"{width}x{height}.npz")
         last_ckpt = time.monotonic()
         chunks_done = 0
+        # Per-run pipeline depth: clamp so depth + 1 board generations fit
+        # the board byte budget (a 2 GB flagship board still pipelines at
+        # 3; a board near device-memory capacity degrades to
+        # sync-per-chunk rather than OOM). The budget comes from the
+        # device's reported memory limit when available (half of it —
+        # kernel temporaries and haloed windows need the rest), else a
+        # conservative default; GOL_PIPELINE_BUDGET (bytes) overrides.
+        budget = env_int("GOL_PIPELINE_BUDGET", 0, minimum=0)
+        if budget <= 0:
+            budget = PIPELINE_BOARD_BUDGET
+            try:
+                cap = (self._devices[0].memory_stats() or {}).get(
+                    "bytes_limit", 0)
+                if cap:
+                    budget = int(cap) // 2
+            except Exception:
+                pass  # platform without memory stats: keep the default
+        # The budget is per device, so compare against this device's SHARD
+        # of the board, not the global array size.
+        shard_bytes = int(cells.nbytes) // max(mesh.size, 1)
+        depth = max(1, min(
+            env_int(PIPELINE_DEPTH_ENV, PIPELINE_DEPTH),
+            8,  # the pace window must always out-span a drain cluster
+            budget // max(shard_bytes, 1) - 1,
+        ))
+        inflight: deque = deque()
+        last_pop = time.monotonic()
+        # The pipeline stays at depth 1 (sync per chunk — the adapter
+        # sees every measurement immediately) while the chunk is still
+        # ramping; once the adapter stops growing it, the pipeline opens
+        # to full depth. Pipelining the ramp instead would lag each
+        # doubling by a full pipeline of stale pops, tripling the number
+        # of round-trip-priced ramp chunks.
+        ramping = True
+        # Size the pace window so a drain cluster (up to `depth` queued
+        # completions popped microseconds apart) can never fill it — the
+        # rate must always span at least a few real completion intervals.
+        self._pace_window = deque(maxlen=depth + 5)
+
+        def _reset_pace(at: float) -> None:
+            """Exclude a host-side stall (compile, checkpoint, pause,
+            trace) from pace measurements: wall time spent there is not
+            chunk compute, for either adapter regime."""
+            nonlocal last_pop
+            last_pop = at
+            self._pace_window.clear()
+
+        def _pop_oldest() -> None:
+            """Block until the oldest in-flight chunk is real; feed its
+            completion to the regime-appropriate chunk adapter (floor-
+            based for synchronous measurements — the ramp and depth-1
+            mode — windowed-rate once the pipeline is open)."""
+            nonlocal chunk, last_pop, ramping
+            done_cells, done_k = inflight.popleft()
+            wait(done_cells)
+            now = time.monotonic()
+            elapsed = now - last_pop
+            last_pop = now
+            if ramping or depth == 1:
+                # Synchronous measurements: elapsed = fixed cost + compute
+                # for exactly this chunk, which is what the floor-based
+                # adapter expects (the windowed est would re-include the
+                # irreducible dispatch floor and shrink toward chunk=1 on
+                # a slow link).
+                new_chunk = self._adapt_chunk(chunk, done_k, elapsed)
+                if ramping and done_k == chunk and new_chunk == chunk:
+                    # Adapter satisfied (in band, or capped): open the
+                    # pipeline. The ramp rule has no hysteresis, so an
+                    # unchanged return is a genuine steady point.
+                    ramping = False
+                chunk = new_chunk
+                rate = done_k / elapsed if elapsed > 0 else 0.0
+            else:
+                chunk = self._adapt_chunk_windowed(chunk, now, done_k)
+                # Single pop-to-pop intervals collapse to ~0 when queued
+                # completions drain together (e.g. right after a
+                # checkpoint barrier) — report the windowed rate instead.
+                rate = self._pace_rate() or 0.0
+            with self._state_lock:
+                self._last_chunk = done_k
+                if rate > 0:
+                    self._turns_per_s = rate
         try:
             while self._turn < target and not quit_run:
                 if self._killed or self._abort.is_set():
@@ -246,20 +349,27 @@ class Engine:
                     or (chunks_done == 0 and k == target - self._turn)
                 )
                 if trace_now:
+                    while inflight:  # a clean profile: nothing else queued
+                        _pop_oldest()
                     with jax.profiler.trace(trace_dir):
                         cells = run(cells, k, mesh, self._rule)
                         wait(cells)
                     trace_dir = ""
+                    _reset_pace(time.monotonic())
                 else:
-                    t0 = time.monotonic()
+                    t_issue = time.monotonic()
                     cells = run(cells, k, mesh, self._rule)
-                    wait(cells)
-                    elapsed = time.monotonic() - t0
-                    chunk = self._adapt_chunk(chunk, k, elapsed)
-                    with self._state_lock:
-                        self._last_chunk = k
-                        if elapsed > 0:
-                            self._turns_per_s = k / elapsed
+                    issue_cost = time.monotonic() - t_issue
+                    if issue_cost > 0.05:
+                        # First dispatch of a new chunk size compiles
+                        # synchronously (~1 s even from the persistent
+                        # cache); shifting last_pop keeps the in-flight
+                        # chunk's own RTT+compute measurable while
+                        # excluding the compile stall.
+                        _reset_pace(last_pop + issue_cost)
+                    inflight.append((cells, k))
+                    while len(inflight) >= (1 if ramping else depth):
+                        _pop_oldest()
                 chunks_done += 1
                 with self._state_lock:
                     self._cells = cells
@@ -268,10 +378,15 @@ class Engine:
                         time.monotonic() - last_ckpt >= ckpt_every:
                     self.save_checkpoint(ckpt_path)
                     last_ckpt = time.monotonic()
+                    _reset_pace(last_ckpt)
                 if self._turn < target:
                     # Only honour flags while turns remain — a pause landing
                     # with the final chunk must not park a finished run.
+                    t_flags = time.monotonic()
                     quit_run = self._handle_flags()
+                    if time.monotonic() - t_flags > 0.01:
+                        # A pause (or slow flag drain) stalled the host.
+                        _reset_pace(time.monotonic())
         finally:
             with self._state_lock:
                 self._running = False
@@ -506,28 +621,86 @@ class Engine:
         return np.asarray(jax.device_get(to_pixels(cells))), turn
 
     def _adapt_chunk(self, chunk: int, k: int, elapsed: float) -> int:
-        """Double/halve the power-of-two chunk so the MARGINAL compute per
-        chunk approaches CHUNK_TARGET_SECONDS.
+        """Ramp-regime adapter (synchronous, one chunk in flight): size
+        the power-of-two chunk so the MARGINAL compute per chunk
+        approaches CHUNK_TARGET_SECONDS.
 
-        Every dispatch carries a fixed host↔device cost (measured ~0.2 s
-        per program round-trip through the axon tunnel — independent of
+        Every dispatch carries a fixed host↔device cost (measured ~0.17 s
+        per program round trip through the axon tunnel — independent of
         chunk size), so adapting on raw `elapsed` deadlocks: at chunk=1
         elapsed is already above any sub-second target and the run stays
         pinned at one turn per round-trip (~5 turns/s on a kernel capable
-        of millions). Instead the adapter tracks the smallest elapsed ever
-        seen (`_fixed_cost_est`, the dispatch floor — no chunk can beat
-        it) and grows while compute-above-floor is under target. Pause /
-        quit / snapshot latency is bounded by floor + 2x target, and the
-        floor is irreducible anyway: even a 1-turn chunk pays it."""
+        of millions). The adapter tracks the smallest elapsed ever seen
+        (`_fixed_cost_est`, the dispatch floor — no chunk can beat it) and
+        grows while compute-above-floor is under target. Growth takes
+        power-of-4 strides because every chunk size visited costs a
+        one-off XLA compile (~1 s even from the persistent cache); an
+        overshooting stride is corrected by at most one halving, still
+        fewer sizes than doubling all the way up.
+
+        Only valid for SYNCHRONOUS measurements (one chunk in flight —
+        the ramp, or GOL_PIPELINE_DEPTH=1): once the dispatch pipeline
+        opens, pop-to-pop times under-measure whenever the host falls
+        behind and completions cluster, so the pipelined regime uses
+        `_adapt_chunk_windowed` instead."""
         if k != chunk:
             return chunk  # partial (remainder) chunk — timing unrepresentative
         self._fixed_cost_est = min(self._fixed_cost_est, elapsed)
         marginal = elapsed - self._fixed_cost_est
-        if marginal < CHUNK_TARGET_SECONDS and chunk * 2 <= self._max_chunk:
-            return chunk * 2
+        if marginal < CHUNK_TARGET_SECONDS:
+            if chunk * 4 <= self._max_chunk:
+                return chunk * 4
+            if chunk * 2 <= self._max_chunk:
+                return chunk * 2
         if marginal > CHUNK_TARGET_SECONDS * 2 and chunk > 1:
             return chunk // 2
         return chunk
+
+    def _adapt_chunk_windowed(self, chunk: int, now: float, k: int) -> int:
+        """Pipelined-regime adapter: estimate the per-turn pace from a
+        sliding window of pop completions and size the chunk so
+        chunk × pace lands in [target, 2×target].
+
+        Individual pop-to-pop times are unusable once chunks overlap — a
+        slow host drains several queued completions microseconds apart,
+        and growing on those near-zero readings runs away until a single
+        chunk takes minutes (the quit-latency bug this replaces). The
+        windowed turns/second rate spans several chunks, so clustered
+        completions and transient stalls (a concurrent Alivecount poll,
+        tunnel jitter) average out; the pipeline's fixed dispatch costs
+        are amortized INTO the rate, which is exactly the pace that
+        matters for control latency."""
+        win = self._pace_window
+        if win and now - win[-1][0] < 0.005:
+            # Clustered completions (queued chunks draining together after
+            # a stall) are ONE pace sample: merging keeps their turns
+            # counted without creating near-zero intervals that would
+            # inflate the windowed rate.
+            win[-1] = (now, win[-1][1] + k)
+        else:
+            win.append((now, k))
+        rate = self._pace_rate()
+        if rate is None:
+            return chunk
+        est = chunk / rate
+        if est < CHUNK_TARGET_SECONDS and chunk * 2 <= self._max_chunk:
+            return chunk * 2
+        if est > CHUNK_TARGET_SECONDS * 2 and chunk > 1:
+            return chunk // 2
+        return chunk
+
+    def _pace_rate(self) -> Optional[float]:
+        """Turns/second across the sliding pop window (turns completed
+        between the first and last pop timestamps); None until the window
+        holds enough pops to be meaningful."""
+        win = self._pace_window
+        if len(win) < 4:
+            return None
+        span = win[-1][0] - win[0][0]
+        turns = sum(kk for _, kk in list(win)[1:])
+        if span <= 0 or turns <= 0:
+            return None
+        return turns / span
 
     def _handle_flags(self) -> bool:
         """Drain flags; block while paused. Returns True to quit the run

@@ -26,7 +26,7 @@ def board(h=32, w=32, seed=1):
 
 def test_next_chunk():
     assert _next_chunk(64, 100) == 64
-    assert _next_chunk(64, 63) == 32
+    assert _next_chunk(64, 63) == 63  # exact remainder: one dispatch
     assert _next_chunk(64, 1) == 1
     assert _next_chunk(1, 5) == 1
     assert _next_chunk(8, 0) == 1  # guarded by caller, still sane
