@@ -86,6 +86,7 @@ MAX_CHUNK_ENV = "GOL_MAX_CHUNK"
 # clamped so those generations fit a fixed byte budget).
 PIPELINE_DEPTH = 3
 PIPELINE_DEPTH_ENV = "GOL_PIPELINE_DEPTH"  # 1 disables (sync per chunk)
+PIPELINE_BUDGET_ENV = "GOL_PIPELINE_BUDGET"  # bytes; overrides device limit
 PIPELINE_BOARD_BUDGET = 8 << 30
 
 # GOL_TRACE=<dir>: dump one jax.profiler trace of a representative chunk
@@ -116,14 +117,19 @@ class EngineBusy(RuntimeError):
 
 
 def _next_chunk(chunk: int, remaining: int) -> int:
-    """min(chunk, remaining): full chunks are powers of two/four (so the
-    set of distinct XLA programs per mesh stays O(log MAX_CHUNK) for the
-    sustained phase), and the final remainder runs as ONE exact-length
-    chunk. Decomposing the remainder into its power-of-two set bits would
-    dispatch up to ~10 sizes the ×4 ramp never compiled — each a ~1 s
-    synchronous compile stall right at the end of the run, where a
-    controller is waiting — versus a single one-off compile here."""
-    return max(min(chunk, remaining), 1)
+    """Largest power of two ≤ min(chunk, remaining). Keeping every
+    compiled loop length a power of two bounds the set of distinct XLA
+    programs per mesh at O(log MAX_CHUNK) — crucial for a long-lived
+    engine (the detach/resume contract) serving runs of arbitrary turn
+    counts, where exact-length remainder chunks would compile one fresh
+    program per distinct count, unbounded over the engine's lifetime.
+    The one-off compiles of the odd-power tail sizes the ×4 ramp skips
+    happen once per process and are amortized by the persistent compile
+    cache thereafter."""
+    k = chunk
+    while k > remaining:
+        k //= 2
+    return max(k, 1)
 
 
 class Engine:
@@ -176,8 +182,10 @@ class Engine:
         # observed for a full chunk); engine-lifetime, it only sharpens.
         self._fixed_cost_est = float("inf")
         # Sliding (pop time, turns) window for the pipelined-regime
-        # adapter; see _adapt_chunk_windowed.
+        # adapter, plus a count of post-reset pops to ignore; see
+        # _adapt_chunk_windowed.
         self._pace_window: deque = deque(maxlen=8)
+        self._pace_skip = 0
         self._max_chunk = MAX_CHUNK
         # Rolling throughput telemetry for the Stats RPC.
         self._last_chunk = 0
@@ -260,7 +268,7 @@ class Engine:
         # device's reported memory limit when available (half of it —
         # kernel temporaries and haloed windows need the rest), else a
         # conservative default; GOL_PIPELINE_BUDGET (bytes) overrides.
-        budget = env_int("GOL_PIPELINE_BUDGET", 0, minimum=0)
+        budget = env_int(PIPELINE_BUDGET_ENV, 0, minimum=0)
         if budget <= 0:
             budget = PIPELINE_BOARD_BUDGET
             try:
@@ -291,14 +299,19 @@ class Engine:
         # completions popped microseconds apart) can never fill it — the
         # rate must always span at least a few real completion intervals.
         self._pace_window = deque(maxlen=depth + 5)
+        self._pace_skip = 0
 
         def _reset_pace(at: float) -> None:
             """Exclude a host-side stall (compile, checkpoint, pause,
             trace) from pace measurements: wall time spent there is not
-            chunk compute, for either adapter regime."""
+            chunk compute, for either adapter regime. Chunks that
+            completed during the stall drain as a burst right after it,
+            so the next `depth` pops are suspect and skipped by the
+            windowed adapter."""
             nonlocal last_pop
             last_pop = at
             self._pace_window.clear()
+            self._pace_skip = depth
 
         def _pop_oldest() -> None:
             """Block until the oldest in-flight chunk is real; feed its
@@ -670,15 +683,19 @@ class Engine:
         tunnel jitter) average out; the pipeline's fixed dispatch costs
         are amortized INTO the rate, which is exactly the pace that
         matters for control latency."""
-        win = self._pace_window
-        if win and now - win[-1][0] < 0.005:
-            # Clustered completions (queued chunks draining together after
-            # a stall) are ONE pace sample: merging keeps their turns
-            # counted without creating near-zero intervals that would
-            # inflate the windowed rate.
-            win[-1] = (now, win[-1][1] + k)
-        else:
-            win.append((now, k))
+        if self._pace_skip > 0:
+            # Pops right after a pace reset may be completions that
+            # finished DURING the excluded stall, draining microseconds
+            # apart — recording them would anchor the fresh window on a
+            # near-zero span and inflate the rate. At most `depth` chunks
+            # can be queued, so skipping that many suspect pops suffices.
+            # (Clusters elsewhere are harmless: the windowed rate is a
+            # true average as long as the span is real, and a mid-window
+            # cluster of ≤ depth entries cannot consume the whole span —
+            # the window out-sizes the pipeline by 5 entries.)
+            self._pace_skip -= 1
+            return chunk
+        self._pace_window.append((now, k))
         rate = self._pace_rate()
         if rate is None:
             return chunk

@@ -26,7 +26,7 @@ def board(h=32, w=32, seed=1):
 
 def test_next_chunk():
     assert _next_chunk(64, 100) == 64
-    assert _next_chunk(64, 63) == 63  # exact remainder: one dispatch
+    assert _next_chunk(64, 63) == 32  # canonical power-of-two tail
     assert _next_chunk(64, 1) == 1
     assert _next_chunk(1, 5) == 1
     assert _next_chunk(8, 0) == 1  # guarded by caller, still sane
@@ -343,3 +343,79 @@ def test_non_square_boards(h, w, turns, shards, recwarn):
         assert downgrades, "expected a shard-downgrade warning"
     else:
         assert not downgrades
+
+
+def test_windowed_adapter_rate_and_bands():
+    """Pipelined-regime chunk adapter: grows when chunk/rate is under
+    target, halves when over 2x, holds in band."""
+    from gol_tpu.engine import CHUNK_TARGET_SECONDS as T
+
+    eng = Engine()
+    eng._max_chunk = 1 << 20
+    # Feed a steady pace: 1024 turns every 0.1*T seconds -> per-turn pace
+    # makes a 1024-chunk cost 0.1*T (far under target) -> grow.
+    t = 0.0
+    chunk = 1024
+    for _ in range(6):
+        t += T * 0.1
+        chunk_before = chunk
+        chunk = eng._adapt_chunk_windowed(chunk_before, t, 1024)
+    assert chunk > 1024  # grew on a genuinely fast pace
+    # Now a slow pace: same chunk takes 3*T per completion -> halve.
+    eng2 = Engine()
+    eng2._max_chunk = 1 << 20
+    t, chunk = 0.0, 4096
+    for _ in range(6):
+        t += T * 3
+        chunk = eng2._adapt_chunk_windowed(chunk, t, 4096)
+    assert chunk < 4096
+
+
+def test_windowed_adapter_immune_to_clustered_completions():
+    """Queued completions draining microseconds apart (a host stall) must
+    NOT read as an astronomically fast pace: the runaway-growth bug the
+    windowed adapter exists to prevent. A mid-window cluster is averaged
+    over the window's REAL span; per-pop timing would see ~5 chunks/ms."""
+    eng = Engine()
+    eng._max_chunk = 1 << 20
+    t = 0.0
+    chunk = 4096
+    for dt in (0.5, 0.5, 0.0001, 0.0001, 0.0001, 0.0001, 0.0001):
+        t += dt
+        chunk = eng._adapt_chunk_windowed(chunk, t, 4096)
+    # 6 * 4096 turns over ~1.0 s of real span: per-chunk ~0.17 s, near
+    # band -> the chunk must not have exploded.
+    assert chunk <= 8192
+
+
+def test_windowed_adapter_skips_suspect_pops_after_reset():
+    """After a pace reset (checkpoint/pause/compile stall), the next
+    `_pace_skip` pops are drain-burst suspects and must not enter the
+    window — a burst anchoring a fresh window at near-zero span would
+    inflate the rate and double the chunk on garbage readings."""
+    eng = Engine()
+    eng._max_chunk = 1 << 20
+    eng._pace_skip = 3  # as _reset_pace(depth=3) would set
+    chunk = 4096
+    t = 0.0
+    # Drain burst: 3 pops within 1 ms — all skipped, window stays empty.
+    for _ in range(3):
+        t += 0.0003
+        chunk = eng._adapt_chunk_windowed(chunk, t, 4096)
+    assert chunk == 4096 and len(eng._pace_window) == 0
+    # Honest completions afterwards are recorded again.
+    for _ in range(5):
+        t += 0.2
+        chunk = eng._adapt_chunk_windowed(chunk, t, 4096)
+    assert len(eng._pace_window) >= 4
+
+
+def test_pace_rate_needs_enough_samples():
+    eng = Engine()
+    assert eng._pace_rate() is None
+    eng._pace_window.append((0.0, 64))
+    eng._pace_window.append((1.0, 64))
+    assert eng._pace_rate() is None  # < 4 samples
+    eng._pace_window.append((2.0, 64))
+    eng._pace_window.append((3.0, 64))
+    assert abs(eng._pace_rate() - 64.0) < 1e-9  # 192 turns over 3 s
