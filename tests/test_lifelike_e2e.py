@@ -12,7 +12,7 @@ import pytest
 from gol_tpu import Params, events as ev
 from gol_tpu.distributor import distributor
 from gol_tpu.engine import Engine
-from gol_tpu.models.lifelike import HIGHLIFE, SEEDS
+from gol_tpu.models.lifelike import HIGHLIFE, SEEDS, LifeLikeRule
 from gol_tpu.server import EngineServer
 
 
@@ -65,6 +65,11 @@ def seeded_images(tmp_path):
 @pytest.mark.parametrize("rule,bs", [
     (HIGHLIFE, ({3, 6}, {2, 3})),
     (SEEDS, ({2}, set())),
+    # B0 (birth on zero neighbours — AntiLife): the LUT tiers handle it
+    # naturally on a finite torus; only the sparse engine rejects it
+    # (a B0 board has no live bounding window).
+    (LifeLikeRule("B0123478/S01234678"), (
+        {0, 1, 2, 3, 4, 7, 8}, {0, 1, 2, 3, 4, 6, 7, 8})),
 ])
 def test_rule_through_full_stack_in_process(
     rule, bs, seeded_images, out_dir, monkeypatch
