@@ -30,9 +30,11 @@ workload, not a trick.
 
 Usage:
     python bench.py                # full matrix: 5120², 65536², sparse,
-                                   # then the 512² north-star line LAST
+                                   # engine stack, then the 512²
+                                   # north-star line LAST
     python bench.py --size 5120    # one dense config
     python bench.py --pattern rpentomino
+    python bench.py --engine       # full-engine-stack 512² sustained run
 """
 
 from __future__ import annotations
@@ -273,6 +275,62 @@ def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
     return 0 if parity is not False else 1
 
 
+ENGINE_TURNS = 30_000_000
+
+
+def bench_engine(turns: int = ENGINE_TURNS) -> int:
+    """Sustained throughput of the FULL engine stack (adaptive chunk
+    pipeline, flag handshakes, state publication) on the 512² fixture —
+    the interactive-run number, as opposed to the raw-kernel legs.
+
+    Parity gate: the seeded fixture board's ash is period-2 from well
+    before turn 10⁴ (7527 alive on even turns, 7525 on odd — the analog
+    of the reference board's 5565/5567 oscillation,
+    `Local/count_test.go:43-49`), so the exact final alive count is
+    known for ANY large turn target."""
+    from gol_tpu.engine import Engine
+    from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.params import Params
+
+    try:
+        world = read_pgm("images/512x512.pgm")
+    except (FileNotFoundError, ValueError):
+        print("BENCH LEG SKIPPED (engine): no 512x512 fixture",
+              file=sys.stderr)
+        return 0
+    # Warmup: a shorter run compiles the chunk-ramp program ladder (same
+    # jit cache) so the timed run measures the engine, not one-off XLA
+    # compiles — the same methodology as the dense legs' warmup. Capped
+    # at the timed length: a small --turns run ramps through the same
+    # (or a shorter) ladder.
+    if turns > 0:
+        Engine().server_distributor(
+            Params(threads=8, image_width=512, image_height=512,
+                   turns=min(2_000_000, turns)), world)
+    p = Params(threads=8, image_width=512, image_height=512, turns=turns)
+    eng = Engine()
+    t0 = time.perf_counter()
+    out, turn = eng.server_distributor(p, world)
+    elapsed = time.perf_counter() - t0
+    alive = int((np.asarray(out) != 0).sum())
+    if turns >= 20_000:  # the fixture's ash is period-2 well before 10^4
+        want = 7527 if turns % 2 == 0 else 7525
+        parity = turn == turns and alive == want
+        how = f"period-2 ash count at turn {turns} (want {want})"
+    else:
+        parity, how = None, "no gate below the ash-settling horizon"
+    _emit(
+        "turns/sec (512x512, full engine stack)",
+        round(turns / elapsed, 1), "turns/s", None,
+        {"turns": turns, "elapsed_s": round(elapsed, 4),
+         "alive": alive, "alive_parity": parity, "parity_check": how},
+    )
+    if parity is False:
+        print(f"PARITY FAIL (engine): turn={turn} alive={alive}",
+              file=sys.stderr)
+    return 0 if parity is not False else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=None,
@@ -289,6 +347,9 @@ def main() -> int:
                     default="dense",
                     help="'dense' (default) or a sparse-torus pattern "
                          "(rpentomino = BASELINE config 5)")
+    ap.add_argument("--engine", action="store_true",
+                    help="run the full-engine-stack 512² sustained leg "
+                         "only (adaptive chunk pipeline + control plane)")
     args = ap.parse_args()
     # Same entry-point cache policy as the CLI/server: the bench compiles
     # ~a dozen distinct programs per matrix run (timed lengths, warmups,
@@ -297,6 +358,13 @@ def main() -> int:
     import gol_tpu
 
     gol_tpu.maybe_enable_default_compile_cache()
+
+    if args.engine:
+        if args.size is not None or args.pattern != "dense":
+            ap.error("--engine is its own config; combine only with "
+                     "--turns")
+        turns = args.turns if args.turns is not None else ENGINE_TURNS
+        return bench_engine(turns)
 
     if args.pattern != "dense":
         if args.size is not None:
@@ -334,6 +402,7 @@ def main() -> int:
     for n in (5120, 65536):
         rc |= leg(bench_dense, n, default_turns(n), args.warmup_turns)
     rc |= leg(bench_sparse, SPARSE_TURNS)
+    rc |= leg(bench_engine)
     rc |= leg(bench_dense, 512, default_turns(512), args.warmup_turns)
     return rc
 

@@ -87,6 +87,20 @@ def test_rule_through_full_stack_in_process(
     np.testing.assert_array_equal(got, want)
 
 
+def test_b0_packed_tier():
+    """B0 through the bit-packed tier: the full-stack case above runs a
+    16-wide board, which `select_representation` routes to the uint8
+    tier — this pins the bit-sliced count-0 mask path (width % 32 == 0)
+    that every packed production board uses."""
+    from gol_tpu.ops.bitpack import pack, packed_run_turns, unpack
+
+    rule = LifeLikeRule("B0123478/S01234678")
+    b = seed_board(32)
+    want = naive_lifelike(b, 6, rule.born, rule.survive)
+    got = np.asarray(unpack(packed_run_turns(pack(b), 6, rule)))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_rule_through_server(seeded_images, out_dir, monkeypatch):
     monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
     monkeypatch.delenv("GOL_RULE", raising=False)
