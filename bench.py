@@ -284,13 +284,25 @@ def bench_engine(turns: int = ENGINE_TURNS) -> int:
     the interactive-run number, as opposed to the raw-kernel legs.
 
     Parity gate: the seeded fixture board's ash is period-2 from well
-    before turn 10⁴ (7527 alive on even turns, 7525 on odd — the analog
-    of the reference board's 5565/5567 oscillation,
-    `Local/count_test.go:43-49`), so the exact final alive count is
-    known for ANY large turn target."""
+    before turn 10⁴ (`gol_tpu/fixtures.py` — the analog of the reference
+    board's 5565/5567 oscillation, `Local/count_test.go:43-49`), so the
+    exact final alive count is known for ANY large turn target."""
+    import os
+
     from gol_tpu.engine import Engine
+    from gol_tpu.fixtures import ASH_512_SETTLED_BY, ash_512_alive
     from gol_tpu.io.pgm import read_pgm
     from gol_tpu.params import Params
+
+    # Ambient GOL_* overrides (fault-injection leftovers like
+    # GOL_MAX_CHUNK=4, checkpointing, a 2-D mesh request) would silently
+    # throttle or reroute this leg while its parity gate stays green —
+    # the exact hazard tests/conftest.py isolates the suite from. Clear
+    # the engine-behavior knobs; the compile cache stays.
+    for var in ("GOL_MAX_CHUNK", "GOL_PIPELINE_DEPTH",
+                "GOL_PIPELINE_BUDGET", "GOL_MESH", "GOL_CKPT",
+                "GOL_CKPT_EVERY", "GOL_TRACE", "GOL_RULE"):
+        os.environ.pop(var, None)
 
     try:
         world = read_pgm("images/512x512.pgm")
@@ -313,8 +325,8 @@ def bench_engine(turns: int = ENGINE_TURNS) -> int:
     out, turn = eng.server_distributor(p, world)
     elapsed = time.perf_counter() - t0
     alive = int((np.asarray(out) != 0).sum())
-    if turns >= 20_000:  # the fixture's ash is period-2 well before 10^4
-        want = 7527 if turns % 2 == 0 else 7525
+    if turns >= 2 * ASH_512_SETTLED_BY:
+        want = ash_512_alive(turns)
         parity = turn == turns and alive == want
         how = f"period-2 ash count at turn {turns} (want {want})"
     else:

@@ -1,0 +1,24 @@
+"""Metadata about the seeded golden fixtures (`images/`, `check/`).
+
+The 512² fixture board's ash is period-2 from well before turn 10⁴
+(computed by the native u64 oracle; the analog of the reference board's
+5565/5567 oscillation, `Local/count_test.go:43-49`). Consumers of the
+oscillation gate — the telemetry contract test and the bench's engine
+leg — share these constants so reseeding the fixture only needs one
+update (regenerate via `tests/make_fixtures.py`, then re-derive with
+`gol_tpu.native.step_torus`).
+"""
+
+# (even-turn alive count, odd-turn alive count) of the settled ash.
+ASH_512_EVEN = 7527
+ASH_512_ODD = 7525
+
+# The ash is provably settled by here; gates keyed to ASH_512_* must not
+# fire below this turn.
+ASH_512_SETTLED_BY = 10_000
+
+
+def ash_512_alive(turn: int) -> int:
+    """Expected alive count of the settled 512² fixture at `turn`
+    (valid for turn >= ASH_512_SETTLED_BY)."""
+    return ASH_512_EVEN if turn % 2 == 0 else ASH_512_ODD

@@ -1,0 +1,90 @@
+"""Structural verification of the communication model documented in
+docs/ARCHITECTURE.md ("Multi-chip scaling model"): the sharded step
+programs are lowered to StableHLO and inspected, pinning
+
+- nearest-neighbour ring exchange: exactly TWO collective_permutes per
+  step body (one up, one down) regardless of shard count or turn count —
+  no hub gather (the reference moves the FULL board through one broker
+  per turn, `Server/gol/distributor.go:104-129`);
+- O(W) per-link bytes: each permute carries halo ROWS, never the board —
+  (T, wp) words under T-turn deep-halo macro-stepping, (1, wp) in the
+  per-turn program;
+- the 1/T amortization: the deep program's scan advances T turns per
+  body, so its 2 permutes fire once per T turns.
+"""
+
+import re
+
+import jax.numpy as jnp
+import pytest
+
+from gol_tpu.models.lifelike import CONWAY
+from gol_tpu.parallel.halo import (
+    _make_compiled_deep_run,
+    _make_compiled_run,
+    _packed_local_step,
+    inner_kind,
+)
+from gol_tpu.parallel.mesh import make_mesh
+
+N_SHARDS = 8
+ROWS, WP = 512, 16  # packed 512x512
+
+
+def permute_operand_shapes(hlo: str):
+    """Row/word dims of every collective_permute operand in the module.
+
+    Guards its own completeness: every permute in the module must match
+    the 2-D ui32 pattern (a future lowering emitting, say, a reshaped
+    3-D operand would otherwise silently escape the shape assertions),
+    and no gather-style collective may appear at all — the 'no hub
+    gather' claim is about the module, not just the permutes found."""
+    shapes = []
+    for m in re.finditer(
+        r'stablehlo\.collective_permute"?\s*\(([^)]*)\)[^\n]*?'
+        r"tensor<(\d+)x(\d+)xui32>",
+        hlo,
+    ):
+        shapes.append((int(m.group(2)), int(m.group(3))))
+    assert len(shapes) == hlo.count("stablehlo.collective_permute"), \
+        "collective_permute with an unrecognized operand pattern"
+    for op in ("all_gather", "all_to_all", "all_reduce", "gather"):
+        assert f"stablehlo.{op}" not in hlo, f"unexpected {op} collective"
+    return shapes
+
+
+def test_deep_halo_program_comm_shape():
+    mesh = make_mesh(N_SHARDS)
+    board = jnp.zeros((ROWS, WP), dtype=jnp.uint32)
+    T = 16
+    window = (ROWS // N_SHARDS + 2 * T, WP)
+    run = _make_compiled_deep_run(
+        mesh, CONWAY, T, inner_kind(mesh, window, T))
+    hlo = run.lower(board, 4).as_text()  # 4 macros = 64 turns
+    shapes = permute_operand_shapes(hlo)
+    # Two ring exchanges (up + down) per T-turn macro body, no others.
+    assert len(shapes) == 2, hlo.count("collective_permute")
+    # Each moves exactly the T-row halo of this shard's packed words —
+    # T x W/32 words = T x W/8 bytes per link per T turns, never O(H*W).
+    assert shapes == [(T, WP), (T, WP)]
+
+
+def test_per_turn_program_comm_shape():
+    mesh = make_mesh(N_SHARDS)
+    board = jnp.zeros((ROWS, WP), dtype=jnp.uint32)
+    run = _make_compiled_run(mesh, CONWAY, _packed_local_step)
+    hlo = run.lower(board, 64).as_text()
+    shapes = permute_operand_shapes(hlo)
+    # One-row halos, two directions, once per turn body.
+    assert shapes == [(1, WP), (1, WP)]
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_permute_count_independent_of_shard_count(n_shards):
+    """Ring traffic scales with the NUMBER of links, not through any
+    hub: the per-shard program always has exactly two permutes."""
+    mesh = make_mesh(n_shards)
+    board = jnp.zeros((ROWS, WP), dtype=jnp.uint32)
+    run = _make_compiled_run(mesh, CONWAY, _packed_local_step)
+    hlo = run.lower(board, 8).as_text()
+    assert len(permute_operand_shapes(hlo)) == 2

@@ -63,7 +63,9 @@ def test_alive_telemetry(images_dir, check_dir, out_dir, monkeypatch):
             # (stabilised before turn 10000; values computed by the
             # native u64 oracle) — the analog of the reference board's
             # 5565/5567 oscillation check (`Local/count_test.go:43-49`).
-            want = 7527 if e.completed_turns % 2 == 0 else 7525
+            from gol_tpu.fixtures import ash_512_alive
+
+            want = ash_512_alive(e.completed_turns)
             assert e.cells_count == want, (
                 f"turn {e.completed_turns}: got {e.cells_count}, "
                 f"want oscillating {want}")
