@@ -132,3 +132,24 @@ def test_rejects_b0_rule():
 
     with pytest.raises(ValueError):
         SparseTorus(2**20, [(0, 0)], LifeLikeRule("B0/S23"))
+
+
+def test_glider_long_haul_exact_position():
+    """Soak the episode scheduler + grow/recenter path over hundreds of
+    cycles: a glider moves exactly (+1, +1) every 4 turns forever, so
+    its cell set after N turns is closed-form. A capped macro keeps the
+    ladder to two compiled depths while still crossing ~750 cells of
+    torus and many window regrowths; any off-by-one in an episode
+    budget, analytic post-grow margin, or origin update shows up as a
+    displaced glider. (The uncapped 20k-turn variant runs as part of
+    the real-chip soak, not the CPU suite — compile cost, not compute,
+    dominates here.)"""
+    glider = [(1, 0), (2, 1), (0, 2), (1, 2), (2, 2)]
+    start = [(x + 500, y + 500) for x, y in glider]
+    sp = SparseTorus(2**20, start)
+    turns = 3_000
+    sp.run(turns, macro=512)
+    d = turns // 4
+    want = {((x + d) % 2**20, (y + d) % 2**20) for x, y in start}
+    assert set(sp.alive_cells()) == want
+    assert sp.turn == turns
