@@ -282,6 +282,7 @@ def distributor(
             reconnect_budget > 0 and getattr(engine, "recoverable", False))
         lost_pending = False       # a loss episode awaits its Reattached
         recovery_deadline = None   # bound on one recovery episode
+        recovering = False         # a loss has happened on this run
         while True:
             run_params = Params(
                 threads=p.threads,
@@ -307,11 +308,22 @@ def distributor(
             except (ConnectionError, OSError):
                 if not recoverable:
                     raise
+                recovering = True
                 now = time.monotonic()
                 if now - submit_t > reconnect_budget:
-                    # The (re)submitted run made real wall-clock progress
-                    # before failing: a NEW outage, not the old episode
-                    # still flapping — grant it a fresh budget.
+                    # The failed submission outlived a WHOLE budget of
+                    # wall clock before dying: a NEW outage, not the old
+                    # episode still flapping — grant a fresh budget. Wall
+                    # clock (not observed turn progress) is deliberately
+                    # the only refresh signal: get_world is not
+                    # token-scoped, so an advancing turn could be a
+                    # FOREIGN controller's run (or a deterministic
+                    # restore-and-recrash loop) and refreshing on it
+                    # would unbound the give-up deadline. The cost is
+                    # that a run losing its engine after less than one
+                    # budget of compute inherits the previous episode's
+                    # remaining budget — bounded unfairness, preferred
+                    # over unbounded retries.
                     recovery_deadline = None
                 if recovery_deadline is None:
                     recovery_deadline = now + reconnect_budget
@@ -335,8 +347,11 @@ def distributor(
                 # stops OUR orphan and is a no-op on a foreign
                 # controller's run, which then keeps failing the resubmit
                 # until the episode deadline re-raises here.
-                if not (recovery_deadline is not None
-                        and hasattr(engine, "abort_run")):
+                # `recovering` marks an active recovery episode (every
+                # path that sets it also arms the deadline): EngineBusy
+                # on a FIRST submission is a foreign-run conflict and
+                # propagates.
+                if not (recovering and hasattr(engine, "abort_run")):
                     raise
                 if time.monotonic() >= recovery_deadline:
                     raise

@@ -402,13 +402,20 @@ class Engine:
                         _reset_pace(time.monotonic())
         finally:
             with self._state_lock:
+                # Capture THIS run's final state in the same critical
+                # section that releases the engine: once _running drops, a
+                # spin-retrying submitter (the partition-recovery flow)
+                # can install a new board, and a later _snapshot() would
+                # hand the first caller the second run's state.
+                final_cells, final_packed = self._cells, self._packed
+                final_turn = self._turn
                 self._running = False
                 self._run_token = None
                 self._abort.clear()
         # On kill_prog mid-run, still hand back the partial board — the
         # state exists and discarding completed turns helps nobody; further
         # RPCs on this engine raise EngineKilled.
-        return self._snapshot()
+        return self._materialize(final_cells, final_packed), final_turn
 
     def alive_count(self) -> Tuple[int, int]:
         """(alive, completed turn), coherent pair (ref `Server:69-75`)."""
@@ -445,11 +452,17 @@ class Engine:
         with self._state_lock:
             if self._running:
                 return
-        while True:
-            try:
-                self._flags.get_nowait()
-            except queue.Empty:
-                return
+            # Drain INSIDE the lock: a run starting in the gap between
+            # the check and the drain could have its controller's early
+            # pause/quit flags wiped by this observer (server_distributor
+            # flips _running under the same lock, so holding it here
+            # excludes that window; cf_put itself is queue-safe and
+            # lock-free).
+            while True:
+                try:
+                    self._flags.get_nowait()
+                except queue.Empty:
+                    return
 
     def kill_prog(self) -> None:
         """Mark the engine dead (ref `Server:77-80`, worker os.Exit)."""
@@ -627,11 +640,17 @@ class Engine:
     def _snapshot(self) -> Tuple[np.ndarray, int]:
         with self._state_lock:
             cells, turn, packed = self._cells, self._turn, self._packed
+        return self._materialize(cells, packed), turn
+
+    @staticmethod
+    def _materialize(cells, packed: bool) -> np.ndarray:
+        """Device board handle -> host {0,255} pixel array (blocks until
+        the handle is real)."""
         if cells is None:
             raise RuntimeError("no board loaded")
         if packed:
             cells = unpack(cells)
-        return np.asarray(jax.device_get(to_pixels(cells))), turn
+        return np.asarray(jax.device_get(to_pixels(cells)))
 
     def _adapt_chunk(self, chunk: int, k: int, elapsed: float) -> int:
         """Ramp-regime adapter (synchronous, one chunk in flight): size

@@ -105,13 +105,19 @@ def write_pgm(path: str, board: np.ndarray) -> None:
     if board.dtype != np.uint8 or board.ndim != 2:
         raise ValueError(f"board must be 2-D uint8, got {board.dtype} "
                          f"shape {board.shape}")
-    bad = (board != 0) & (board != MAXVAL)
-    if bad.any():
+    # Validate via two sequential count_nonzero passes: one transient
+    # bool temporary at a time (~4.3 GB peak on the 65536² finalize path)
+    # vs ~13 GB for the combined boolean-mask expression. (bincount would
+    # be worse still — numpy casts the input to an 8-byte intp copy.)
+    ok = (np.count_nonzero(board == 0)
+          + np.count_nonzero(board == MAXVAL))
+    bad = int(board.size - ok)
+    if bad:
         # Fail at the write site — the usual bug is passing the internal
         # {0,1} cells array instead of pixels; writing it would produce a
         # file read_pgm itself rejects, far from the cause.
         raise ValueError(
-            f"{int(bad.sum())} cells not in {{0, {MAXVAL}}} "
+            f"{bad} cells not in {{0, {MAXVAL}}} "
             "(pass pixels, not {0,1} cells)")
     from gol_tpu import native
 

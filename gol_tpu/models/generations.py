@@ -89,7 +89,13 @@ def _step(state: jax.Array, rule: GenerationsRule) -> jax.Array:
     c = rule.states
     # dead -> 1 if born; alive -> 1 if surviving else first dying state
     # (which for C == 2 IS death); dying -> next state, death after C-1.
-    dying_next = jnp.where(state + 1 < c, state + 1, 0).astype(jnp.uint8)
+    # Equality form stays entirely in uint8 — the naive `state + 1 < c`
+    # breaks at c == 256 (a uint8 `state + 1` wraps 255 -> 0 and
+    # `anything < 256` is always false, killing every dying cell after
+    # one turn). Valid states are < c, so `state + 1` in the taken
+    # branch never wraps.
+    dying_next = jnp.where(
+        state == c - 1, jnp.uint8(0), state + 1).astype(jnp.uint8)
     out = jnp.where(
         state == 0,
         born_lut[n],

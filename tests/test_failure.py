@@ -701,3 +701,65 @@ def test_sigkill_restart_resume_e2e(images_dir, out_dir, tmp_path,
             if proc is not None and proc.poll() is None:
                 proc.terminate()
                 proc.wait(10)
+
+
+class SecondOutageEngine:
+    """Outage A kills the first submission instantly; the resubmission
+    then survives 2.5 s (longer than the whole GOL_RECONNECT budget)
+    before outage B takes the engine down for good. Pings always
+    answer."""
+
+    recoverable = True
+
+    def __init__(self):
+        self.attempts = 0
+
+    def server_distributor(self, *a, **k):
+        self.attempts += 1
+        if self.attempts == 2:
+            time.sleep(2.5)  # sustained run before the new outage
+        raise ConnectionError("down")
+
+    def ping(self):
+        return 0
+
+    def get_world(self):
+        raise RuntimeError("no board loaded")
+
+    def alive_count(self):
+        return (0, 0)
+
+    def cf_put(self, flag):
+        pass
+
+    def drain_flags(self):
+        pass
+
+    def abort_run(self):
+        return False
+
+
+def test_new_outage_after_budget_long_run_gets_fresh_budget(
+        images_dir, out_dir, monkeypatch):
+    """An outage striking a resubmission that survived longer than a
+    whole GOL_RECONNECT budget is a NEW episode and gets a full fresh
+    budget — not the dregs of the previous episode's deadline (which
+    here expired during the 2.5 s run, so the stale deadline would give
+    up on outage B's FIRST failure). Tight flaps (submissions dying in
+    milliseconds) never clear the wall-clock bar, so the flapping test
+    above still bounds them to one episode."""
+    monkeypatch.setenv("GOL_RECONNECT", "2")
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    eng = SecondOutageEngine()
+    p = Params(threads=2, image_width=64, image_height=64, turns=100)
+    q = queue.Queue()
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        distributor(p, q, None, engine=eng,
+                    images_dir=images_dir, out_dir=out_dir)
+    elapsed = time.monotonic() - t0
+    # 2.5 s of sustained run + a FULL fresh 2 s episode for outage B;
+    # the stale (expired) deadline would end everything at ~2.5 s.
+    assert elapsed >= 4.0, elapsed
+    assert eng.attempts >= 3

@@ -49,7 +49,11 @@ def test_rule_parsing_and_canon():
 
 
 @pytest.mark.parametrize("rule", [BRIANS_BRAIN, STAR_WARS,
-                                  GenerationsRule("23/3/5")])
+                                  GenerationsRule("23/3/5"),
+                                  # the uint8 ceiling: `state + 1 < 256`
+                                  # must be computed wider than uint8 or
+                                  # every dying cell dies after one turn
+                                  GenerationsRule("/2/256")])
 def test_matches_naive_oracle(rule):
     rng = np.random.default_rng(13)
     board = rng.integers(0, rule.states, size=(24, 24)).astype(np.uint8)
