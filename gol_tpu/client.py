@@ -185,8 +185,9 @@ class RemoteEngine:
         self._call({"method": "CFput", "flag": int(flag)},
                    timeout=self._timeout)
 
-    def drain_flags(self) -> None:
-        self._call({"method": "DrainFlags"}, timeout=self._timeout)
+    def drain_flags(self, pause_only: bool = False) -> None:
+        self._call({"method": "DrainFlags", "pause_only": pause_only},
+                   timeout=self._timeout)
 
     def kill_prog(self) -> None:
         self._call({"method": "KillProg"}, timeout=self._timeout)

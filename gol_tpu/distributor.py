@@ -138,6 +138,15 @@ def distributor(
     width, height = p.image_width, p.image_height
     done = threading.Event()
     kp_state = {"k": False}
+    # Shared pause state (keypress thread toggles, recovery loop reads
+    # and resets): a controller-local bool could silently invert against
+    # the engine across a loss/reattach cycle.
+    pause_requested = threading.Event()
+    # Set for the span of a loss episode (EngineLost .. reattach reset):
+    # 'p' presses inside it are dropped — a pause flag posted to an
+    # engine whose run is being torn down/resubmitted pairs with nothing
+    # and would invert controller-vs-engine pause state.
+    in_recovery = threading.Event()
 
     # Engine resolution can fail (backend init, bad GOL_RULE, …) — it
     # must happen under the finally that delivers CLOSE, or every
@@ -158,7 +167,6 @@ def distributor(
 
     # -- keypress goroutine (`Local/gol/distributor.go:107-152`) ----------
     def keypress_loop() -> None:
-        paused = False
         while not done.is_set():
             try:
                 key = key_presses.get(timeout=0.1)
@@ -173,12 +181,18 @@ def distributor(
                         ev.ImageOutputComplete(turn, os.path.basename(fname))
                     )
                 elif key == "p":
+                    if in_recovery.is_set():
+                        continue  # see in_recovery above
                     engine.cf_put(FLAG_PAUSE)
-                    # The flag is committed: toggle local state BEFORE the
-                    # (fallible) turn poll, or a transient failure there
-                    # would leave controller and engine pause-inverted
-                    # for the rest of the run.
-                    paused = not paused
+                    # The flag is committed: toggle shared state BEFORE
+                    # the (fallible) turn poll, or a transient failure
+                    # there would leave controller and engine
+                    # pause-inverted for the rest of the run.
+                    paused = not pause_requested.is_set()
+                    if paused:
+                        pause_requested.set()
+                    else:
+                        pause_requested.clear()
                     try:
                         _, turn = engine.alive_count()
                     except (ConnectionError, OSError, RuntimeError):
@@ -283,6 +297,15 @@ def distributor(
         lost_pending = False       # a loss episode awaits its Reattached
         recovery_deadline = None   # bound on one recovery episode
         recovering = False         # a loss has happened on this run
+
+        def _close_recovery(turn: int) -> None:
+            """A pause cannot survive engine loss (see the reattach
+            drain): reset the shared pause state and tell consumers the
+            run resumes executing. Shared by BOTH reattach paths."""
+            if pause_requested.is_set():
+                pause_requested.clear()
+                events_q.put(ev.StateChange(turn, ev.State.EXECUTING))
+
         while True:
             run_params = Params(
                 threads=p.threads,
@@ -301,6 +324,8 @@ def distributor(
                     # consumers always see paired Lost/Reattached events.
                     events_q.put(ev.EngineReattached(final_turn))
                     lost_pending = False
+                    _close_recovery(final_turn)
+                in_recovery.clear()
                 break
             except EngineKilled:
                 final_world, final_turn = world, start_turn
@@ -331,6 +356,7 @@ def distributor(
                     raise  # episode budget exhausted — stop flapping
                 else:
                     time.sleep(0.1)  # damp a flapping link's retry spin
+                in_recovery.set()
                 if not lost_pending:
                     events_q.put(ev.EngineLost(start_turn))
                     lost_pending = True
@@ -353,6 +379,7 @@ def distributor(
                 # propagates.
                 if not (recovering and hasattr(engine, "abort_run")):
                     raise
+                in_recovery.set()  # busy retries are recovery too
                 if time.monotonic() >= recovery_deadline:
                     raise
                 try:
@@ -384,9 +411,42 @@ def distributor(
                 # fail back into the recovery branch.
                 contacted = False
             turns_left = max(p.turns - start_turn, 0)
+            if contacted:
+                try:
+                    # Wipe PAUSE flags stranded by the pre-loss session
+                    # (a SURVIVED engine's queue may hold a pause posted
+                    # at the instant of loss that the aborted orphan
+                    # never consumed) so the resubmitted run really does
+                    # start unpaused. pause_only: a stranded quit/kill
+                    # is an idempotent order the resubmitted run SHOULD
+                    # honour. Runs on EVERY recovery cycle because it is
+                    # a no-op while our orphan still occupies the engine
+                    # — only after the EngineBusy cycle aborts the
+                    # orphan (engine parked) does it actually fire,
+                    # right before the resubmission it protects.
+                    # Residual window: a cf_put in flight across the
+                    # whole episode that lands between this drain and
+                    # the resubmit can still strand a pause (control
+                    # RPCs carry a 10 s timeout, so the straddle is rare
+                    # and bounded — and it strands TOGETHER with the
+                    # keypress thread's state toggle, which keeps
+                    # controller and engine consistent).
+                    engine.drain_flags(pause_only=True)
+                except EngineKilled:
+                    final_world, final_turn = world, start_turn
+                    break
+                except (ConnectionError, OSError, RuntimeError,
+                        AttributeError, TypeError):
+                    pass
             if lost_pending and contacted:
                 events_q.put(ev.EngineReattached(start_turn))
                 lost_pending = False
+                _close_recovery(start_turn)
+            # 'p' presses may flow into the resubmission (ordered after
+            # the pause reset — both happen on this thread); a pre-run
+            # pause posted now is consumed by the next run and pairs
+            # with the keypress thread's toggle, which is consistent.
+            in_recovery.clear()
 
         # -- finalize (`:187-226`) ----------------------------------------
         # Reference contract: the final event carries the alive-cell set

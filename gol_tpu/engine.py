@@ -440,14 +440,20 @@ class Engine:
             raise ValueError(f"unknown control flag {flag}")
         self._flags.put(flag)
 
-    def drain_flags(self) -> None:
+    def drain_flags(self, pause_only: bool = False) -> None:
         """Discard STALE control flags — those left by a previous
         (detached/dead) controller session on a PARKED engine. A no-op
         while a run is in flight: an attaching observer must not be able
         to wipe the running controller's pause/quit flags out of the
         queue (flags are not token-scoped the way abort_run is).
         Reference analog: the broker's flag channel is emptied by its
-        per-turn sentinel cycle, `Server:136-150`."""
+        per-turn sentinel cycle, `Server:136-150`.
+
+        `pause_only` drops only FLAG_PAUSE entries (re-queuing the rest
+        in order): the loss-recovery path uses it because a stranded
+        pause toggle would invert controller-vs-engine pause state on
+        the resubmitted run, while a stranded quit/kill is an idempotent
+        order the resubmitted run SHOULD honour."""
         self._check_alive()
         with self._state_lock:
             if self._running:
@@ -458,11 +464,16 @@ class Engine:
             # flips _running under the same lock, so holding it here
             # excludes that window; cf_put itself is queue-safe and
             # lock-free).
-            while True:
-                try:
-                    self._flags.get_nowait()
-                except queue.Empty:
-                    return
+            kept = []
+            try:
+                while True:
+                    flag = self._flags.get_nowait()
+                    if pause_only and flag != FLAG_PAUSE:
+                        kept.append(flag)
+            except queue.Empty:
+                pass
+            for flag in kept:
+                self._flags.put(flag)
 
     def kill_prog(self) -> None:
         """Mark the engine dead (ref `Server:77-80`, worker os.Exit)."""
@@ -598,6 +609,14 @@ class Engine:
                     raise ValueError(
                         f"{path}: inconsistent packed checkpoint "
                         f"({words.shape} words for width {width})")
+                if words.dtype != np.uint32:
+                    # A foreign-tooled/tampered file: device_put would
+                    # silently downcast (x64 disabled) and the carry-save
+                    # kernels would evolve a bit-reinterpreted board, far
+                    # from this load site.
+                    raise ValueError(
+                        f"{path}: packed words must be uint32, "
+                        f"got {words.dtype}")
                 cells = jax.device_put(words)
             else:
                 world = z["world"]  # legacy / unpacked pixel format

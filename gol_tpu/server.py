@@ -106,7 +106,8 @@ class EngineServer:
                 self.engine.cf_put(int(header["flag"]))
                 send_msg(conn, {"ok": True})
             elif method == "DrainFlags":
-                self.engine.drain_flags()
+                self.engine.drain_flags(
+                    pause_only=bool(req.get("pause_only", False)))
                 send_msg(conn, {"ok": True})
             elif method == "KillProg":
                 self.engine.kill_prog()

@@ -14,12 +14,13 @@ followed by exactly H*W raw payload bytes when "world" is present.
 from __future__ import annotations
 
 import json
-import os
 import socket
 import struct
 from typing import Optional, Tuple
 
 import numpy as np
+
+from gol_tpu.utils.envcfg import env_int
 
 _LEN = struct.Struct(">I")
 MAX_HEADER = 1 << 20
@@ -31,8 +32,6 @@ MAX_HEADER = 1 << 20
 # at RUNTIME via GOL_MAX_BOARD_CELLS (read per message, not frozen at
 # import, so server processes can be reconfigured the same way SER/CONT
 # are).
-from gol_tpu.utils.envcfg import env_int
-
 DEFAULT_MAX_BOARD_CELLS = 1 << 35
 
 

@@ -419,3 +419,21 @@ def test_pace_rate_needs_enough_samples():
     eng._pace_window.append((2.0, 64))
     eng._pace_window.append((3.0, 64))
     assert abs(eng._pace_rate() - 64.0) < 1e-9  # 192 turns over 3 s
+
+
+def test_drain_flags_pause_only_preserves_orders():
+    """pause_only drops FLAG_PAUSE entries but re-queues quit/kill in
+    order — stranded idempotent orders must survive loss recovery."""
+    import queue as _queue
+
+    eng = Engine()
+    for f in (FLAG_PAUSE, FLAG_QUIT, FLAG_PAUSE, FLAG_KILL):
+        eng.cf_put(f)
+    eng.drain_flags(pause_only=True)
+    flags = []
+    while True:
+        try:
+            flags.append(eng._flags.get_nowait())
+        except _queue.Empty:
+            break
+    assert flags == [FLAG_QUIT, FLAG_KILL]

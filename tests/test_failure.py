@@ -763,3 +763,80 @@ def test_new_outage_after_budget_long_run_gets_fresh_budget(
     # the stale (expired) deadline would end everything at ~2.5 s.
     assert elapsed >= 4.0, elapsed
     assert eng.attempts >= 3
+
+
+class PausedThenLostEngine:
+    """Accepts a pause flag mid-run, then drops the connection; the
+    recovered resubmission completes normally."""
+
+    recoverable = True
+
+    def __init__(self):
+        self.attempts = 0
+        self.flags = []
+
+    def server_distributor(self, params, world, sub_workers=(),
+                           start_turn=0, token=None):
+        import numpy as np
+
+        self.attempts += 1
+        if self.attempts == 1:
+            time.sleep(0.8)  # long enough for the timed 'p' keypress
+            raise ConnectionError("link dropped while paused")
+        return np.zeros((64, 64), dtype=np.uint8), params.turns + start_turn
+
+    def ping(self):
+        return 0
+
+    def get_world(self):
+        import numpy as np
+
+        return np.zeros((64, 64), dtype=np.uint8), 10
+
+    def alive_count(self):
+        return (0, 10)
+
+    def cf_put(self, flag):
+        self.flags.append(flag)
+
+    def drain_flags(self):
+        pass
+
+    def abort_run(self):
+        return False
+
+
+def test_pause_state_resets_on_reattach(images_dir, out_dir, monkeypatch):
+    """A pause active when the engine is lost cannot survive recovery
+    (the resubmitted run starts unpaused); the controller must reset its
+    shared pause state and emit StateChange(EXECUTING) — otherwise the
+    next 'p' pauses the engine while printing 'Continuing' (controller
+    and engine pause-inverted for the rest of the run)."""
+    monkeypatch.setenv("GOL_RECONNECT", "5")
+    monkeypatch.delenv("SER", raising=False)
+    monkeypatch.delenv("CONT", raising=False)
+    eng = PausedThenLostEngine()
+    p = Params(threads=2, image_width=64, image_height=64, turns=40)
+    q = queue.Queue()
+    keys = queue.Queue()
+    threading.Timer(0.3, lambda: keys.put("p")).start()
+    distributor(p, q, keys, engine=eng,
+                images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(q)
+    kinds = [type(e).__name__ for e in evs]
+    # user pause -> loss -> reattach -> auto-resume notification
+    # (the very first StateChange is the run-start EXECUTING)
+    i_paused = next((i for i, e in enumerate(evs)
+                     if isinstance(e, ev.StateChange)
+                     and e.new_state == ev.State.PAUSED), None)
+    assert i_paused is not None, kinds
+    i_lost = kinds.index("EngineLost")
+    i_back = kinds.index("EngineReattached")
+    execs = [i for i, e in enumerate(evs)
+             if isinstance(e, ev.StateChange)
+             and e.new_state == ev.State.EXECUTING and i > i_back]
+    assert execs, kinds
+    assert i_paused < i_lost < i_back < execs[0], kinds
+    from gol_tpu.engine import FLAG_PAUSE
+
+    assert eng.flags.count(FLAG_PAUSE) == 1  # no flag re-assertion
