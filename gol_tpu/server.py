@@ -107,7 +107,7 @@ class EngineServer:
                 send_msg(conn, {"ok": True})
             elif method == "DrainFlags":
                 self.engine.drain_flags(
-                    pause_only=bool(req.get("pause_only", False)))
+                    pause_only=bool(header.get("pause_only", False)))
                 send_msg(conn, {"ok": True})
             elif method == "KillProg":
                 self.engine.kill_prog()

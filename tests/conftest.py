@@ -35,6 +35,86 @@ if str(REPO_ROOT) not in sys.path:
 
 import pytest
 
+# ---------------------------------------------------------------------------
+# Per-test wall-clock timeout (VERDICT r3 "make red impossible to miss").
+# pytest-timeout is not in the image, so this is the same SIGALRM mechanism
+# its `signal` method uses: a wedged event queue (the round-3 failure mode,
+# where a dead distributor thread never delivers CLOSE) now fails the ONE
+# offending test with a thread dump in bounded time instead of hanging the
+# whole suite for 300+ s per test. Override per test with
+# @pytest.mark.timeout(seconds); disable via GOL_TEST_TIMEOUT=0.
+TEST_TIMEOUT_DEFAULT = float(os.environ.get("GOL_TEST_TIMEOUT", "180"))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): per-test wall-clock limit (SIGALRM; "
+        "default GOL_TEST_TIMEOUT or 180 s)")
+
+
+def _timeout_limit(item) -> float:
+    marker = item.get_closest_marker("timeout")
+    if marker is None:
+        return TEST_TIMEOUT_DEFAULT
+    if marker.args:
+        return float(marker.args[0])
+    return float(marker.kwargs.get("seconds", TEST_TIMEOUT_DEFAULT))
+
+
+def _alarm_guard(item, phase: str):
+    """Context-manager-shaped hookwrapper body: arm SIGALRM around one
+    runtest phase. Covers setup and teardown too — a fixture that wedges
+    (e.g. a shutdown blocking on a stuck socket) hangs the suite just as
+    unboundedly as a wedged test body."""
+    import contextlib
+    import signal
+    import threading
+
+    @contextlib.contextmanager
+    def guard():
+        limit = _timeout_limit(item)
+        if (limit <= 0
+                or threading.current_thread() is not threading.main_thread()):
+            yield
+            return
+
+        def _on_alarm(signo, frame):
+            import faulthandler
+
+            faulthandler.dump_traceback(file=sys.stderr)
+            pytest.fail(
+                f"{phase} exceeded {limit:g}s wall-clock timeout "
+                f"(thread dump on stderr)", pytrace=False)
+
+        old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.setitimer(signal.ITIMER_REAL, limit)
+        try:
+            yield
+        finally:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, old_handler)
+
+    return guard()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    with _alarm_guard(item, "setup"):
+        yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    with _alarm_guard(item, "test"):
+        yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    with _alarm_guard(item, "teardown"):
+        yield
+
 
 @pytest.fixture(autouse=True)
 def _isolate_gol_env(monkeypatch):

