@@ -44,6 +44,11 @@ def _check_resp(resp: dict):
             raise EngineKilled(err)
         if err.startswith("busy:"):
             raise EngineBusy(err)
+        if err.startswith("overloaded:"):
+            # Server shed this connection (cap reached): a transient
+            # transport condition, not an engine state — surface like a
+            # network failure so recovery/retry paths apply.
+            raise ConnectionError(err)
         raise RuntimeError(f"engine error: {err}")
     return resp
 

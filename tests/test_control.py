@@ -126,6 +126,37 @@ def test_pause_actually_stops_turns(images_dir, out_dir, monkeypatch):
     _drain_to_close(events_q)
 
 
+def test_quit_latency_bound(images_dir, out_dir, monkeypatch):
+    """Pin the documented control-latency bound (engine.py chunking
+    policy + pipeline comment): a control flag lands within roughly
+    (pipeline depth + 1) x chunk wall. With GOL_CHUNK_TARGET=0.05 the
+    adapter keeps chunks in a [0.05, 0.1] s wall band, so a quit on an
+    unbounded run must complete in ~0.4 s of engine time — asserted at
+    5 s to absorb CI jitter and ramp-tail compiles, still an order of
+    magnitude under the unbounded-regression alternative."""
+    monkeypatch.setenv("GOL_CHUNK_TARGET", "0.05")
+    engine = Engine()
+    p = Params(threads=1, image_width=64, image_height=64, turns=10**9)
+    events_q, keys = queue.Queue(), queue.Queue()
+    t = run(p, events_q, keys, engine=engine,
+            images_dir=images_dir, out_dir=out_dir)
+    # Let the ramp reach steady state (turn advancing past first chunks).
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        _, turn = engine.alive_count()
+        if turn > 1000:
+            break
+        time.sleep(0.2)
+    t0 = time.monotonic()
+    keys.put("q")
+    t.join(30)
+    latency = time.monotonic() - t0
+    assert not t.is_alive(), "quit never completed"
+    assert latency < 5.0, f"quit took {latency:.1f}s"
+    evs = _drain_to_close(events_q)
+    assert any(isinstance(x, ev.FinalTurnComplete) for x in evs)
+
+
 def test_final_event_cell_list_capped_for_giant_boards(
     images_dir, out_dir, monkeypatch
 ):

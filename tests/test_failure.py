@@ -335,7 +335,11 @@ def test_recovery_quits_orphaned_run(images_dir, out_dir, monkeypatch):
     monkeypatch.delenv("CONT", raising=False)
     monkeypatch.delenv("SUB", raising=False)
 
-    turns = 8000
+    # Sized so the chunk-capped orphan is still mid-run when the
+    # controller resubmits (r4: token-based chunk pops made a capped
+    # 64² engine ~4x faster — 8000 turns finished inside the 0.5 s
+    # partition head start and the abort path never fired).
+    turns = 60_000
     eng = PartitionEngine(Engine())
     p = Params(threads=2, image_width=64, image_height=64, turns=turns)
     q = queue.Queue()

@@ -120,6 +120,7 @@ def _scan_counts_packed(board_pixels: np.ndarray, turns: int) -> np.ndarray:
     return np.asarray(go(pack(cells)))
 
 
+@pytest.mark.timeout(600)
 @pytest.mark.parametrize("size", PACKABLE_SIZES)
 def test_alive_counts_10000_turns_packed(size):
     """Packed tier matches the reference's per-turn alive counts for ALL

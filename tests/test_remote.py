@@ -261,6 +261,70 @@ def test_recv_msg_bounds_unit():
         b.close()
 
 
+def test_stalling_client_is_shed(monkeypatch):
+    """Hostile PACING (VERDICT r3 weak #6): a client that connects and
+    sends nothing must be dropped within the header timeout, not pin a
+    connection thread forever."""
+    import socket
+
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    monkeypatch.setenv("GOL_HDR_TIMEOUT", "1.0")
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.settimeout(5.0)
+        t0 = time.monotonic()
+        # The server closes an idle connection after GOL_HDR_TIMEOUT: our
+        # recv then observes EOF (b"") within seconds.
+        assert s.recv(1) == b""
+        assert time.monotonic() - t0 < 4.0
+        s.close()
+        # and the server still serves well-formed clients
+        eng = RemoteEngine(f"127.0.0.1:{srv.port}")
+        assert eng.ping() == 0
+    finally:
+        srv.shutdown()
+
+
+def test_connection_cap(monkeypatch):
+    """Thread-pool bound: beyond GOL_MAX_CONNS concurrent connections the
+    server refuses with a 'busy' error instead of spawning unboundedly,
+    and recovers once the hogs disconnect."""
+    import socket
+
+    from gol_tpu.wire import recv_msg
+
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    monkeypatch.setenv("GOL_MAX_CONNS", "2")
+    monkeypatch.setenv("GOL_HDR_TIMEOUT", "30")
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    try:
+        hogs = [socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+                for _ in range(2)]
+        time.sleep(0.3)  # let both hogs claim their slots
+        s3 = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s3.settimeout(5.0)
+        resp, _ = recv_msg(s3)
+        assert resp["ok"] is False and "connection limit" in resp["error"]
+        s3.close()
+        for h in hogs:
+            h.close()
+        # slots free again: normal service resumes
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                assert RemoteEngine(f"127.0.0.1:{srv.port}").ping() == 0
+                break
+            except (RuntimeError, ConnectionError, OSError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+    finally:
+        srv.shutdown()
+
+
 def test_cross_process_detach_reattach(images_dir, out_dir, tmp_path):
     """The flagship resilience story across a REAL process boundary
     (reference `Local/gol/distributor.go:171-178`): controller 1 quits
