@@ -186,6 +186,12 @@ class RemoteEngine:
                                  timeout=self._timeout)
         return world, int(resp["turn"])
 
+    def get_window(self):
+        """Sparse engines: (window pixels, (ox, oy) torus origin, turn)."""
+        resp, world = self._call({"method": "GetWindow"},
+                                 timeout=self._timeout)
+        return world, (int(resp["ox"]), int(resp["oy"])), int(resp["turn"])
+
     def cf_put(self, flag: int) -> None:
         self._call({"method": "CFput", "flag": int(flag)},
                    timeout=self._timeout)

@@ -56,17 +56,45 @@ _default_engine_lock = threading.Lock()
 
 
 def _resolve_rule(rule=None):
-    """The life-like rule for in-process engines: an explicit argument
-    wins, else GOL_RULE env (e.g. 'B36/S23' for HighLife), default Conway.
+    """The rule for in-process engines AND controller-side io semantics:
+    an explicit argument wins, else GOL_RULE env — life-like ('B36/S23'
+    HighLife) or Generations ('/2/3' Brian's Brain) — default Conway.
     A malformed rulestring raises — silently defaulting would corrupt a
     run. Beyond-reference: the Go kernel hardcodes Conway
     (`SubServer/distributor.go:179-201`)."""
-    from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+    from gol_tpu.models import parse_rule
+    from gol_tpu.models.lifelike import CONWAY
 
     if rule is not None:
         return rule
     s = os.environ.get("GOL_RULE", "")
-    return LifeLikeRule(s) if s else CONWAY
+    return parse_rule(s) if s else CONWAY
+
+
+# Process-local sparse engine (one, keyed by (size, rulestring)): the
+# sparse analog of `_default_engine` — it outlives `run` calls so the
+# in-process detach/reattach contract (`q` then CONT=yes) holds for
+# sparse runs too.
+_default_sparse: dict = {}
+
+
+def _resolve_sparse_engine(size: int, rule=None):
+    from gol_tpu.sparse_engine import SparseEngine
+
+    ser = os.environ.get("SER", "")
+    if ser:
+        from gol_tpu.client import RemoteEngine
+
+        return RemoteEngine(ser)
+    rule = _resolve_rule(rule)
+    key = (size, rule.rulestring)
+    with _default_engine_lock:
+        eng = _default_sparse.get(key)
+        if eng is None or eng._killed:
+            _default_sparse.clear()  # one held sparse state at a time
+            eng = SparseEngine(size, rule=rule)
+            _default_sparse[key] = eng
+        return eng
 
 
 def _resolve_engine(rule=None):
@@ -131,9 +159,26 @@ def distributor(
     out_dir: Optional[str] = None,
     live_view: bool = False,
     rule=None,
+    sparse: bool = False,
 ) -> None:
+    """`sparse`: run on the sparse-torus engine — Params' width/height
+    give the TORUS size, the board source is the small seed pattern
+    `<images_dir>/seed.pgm` (its live cells stamped centred), snapshots
+    and the final PGM are the live WINDOW (named by window dims), and
+    FinalTurnComplete carries torus-coordinate cells."""
     images_dir = images_dir or os.environ.get("GOL_IMAGES", "images")
     out_dir = out_dir or os.environ.get("GOL_OUT", "out")
+
+    if sparse and live_view:
+        # The live view renders into a Params-sized display (a 2^20
+        # torus would be a 1 TiB buffer) with window-LOCAL coordinates —
+        # neither is meaningful for a sparse run yet. Snapshots ('s')
+        # are the sparse visualisation path.
+        import warnings
+
+        warnings.warn("live view is not supported for sparse runs; "
+                      "disabled (use 's' snapshots instead)")
+        live_view = False
 
     width, height = p.image_width, p.image_height
     done = threading.Event()
@@ -152,7 +197,21 @@ def distributor(
     # must happen under the finally that delivers CLOSE, or every
     # consumer of the events queue hangs forever on a failed startup.
     try:
-        engine = engine if engine is not None else _resolve_engine(rule)
+        if engine is None:
+            engine = (_resolve_sparse_engine(width, rule) if sparse
+                      else _resolve_engine(rule))
+        # Controller-side io semantics for the rule FAMILY: PGM value
+        # levels and the firing-cell pixel mask. For remote engines the
+        # server's --rule governs evolution; GOL_RULE here mirrors it
+        # for io (documented on both --rule flags). Multi-state boards
+        # use the gray encoding of `models/generations.gray_levels`;
+        # "alive" is the firing state's 255 pixel — which for life-like
+        # boards is exactly the reference's 255-cell contract.
+        io_rule = _resolve_rule(rule)
+        from gol_tpu.models.generations import GenerationsRule, gray_levels
+
+        pgm_levels = (tuple(gray_levels(io_rule).tolist())
+                      if isinstance(io_rule, GenerationsRule) else None)
     except BaseException:
         done.set()
         events_q.put(ev.CLOSE)
@@ -167,9 +226,15 @@ def distributor(
                 continue
             try:
                 if key == "s":
-                    world, turn = engine.get_world()
-                    fname = output_path(width, height, turn, out_dir)
-                    write_pgm(fname, world)
+                    if sparse:
+                        win, _, turn = engine.get_window()
+                        fname = output_path(
+                            win.shape[1], win.shape[0], turn, out_dir)
+                        write_pgm(fname, win)
+                    else:
+                        world, turn = engine.get_world()
+                        fname = output_path(width, height, turn, out_dir)
+                        write_pgm(fname, world, levels=pgm_levels)
                     events_q.put(
                         ev.ImageOutputComplete(turn, os.path.basename(fname))
                     )
@@ -243,6 +308,8 @@ def distributor(
             if turn == prev_turn:
                 continue
             cur = world != 0
+            if prev is not None and prev.shape != cur.shape:
+                prev = None  # window regrew (sparse engine): full repaint
             if prev is None:
                 ys, xs = np.nonzero(cur)
             else:
@@ -267,6 +334,23 @@ def distributor(
                 RuntimeError):
             pass
 
+        if sparse:
+            # A REMOTE sparse engine's torus size is server state
+            # (`--sparse SIZE`); a mismatched controller would silently
+            # wrap final torus coordinates by the wrong modulus. Fail
+            # fast on a definite mismatch; transport errors here are
+            # not a verdict and fall through to the run itself.
+            try:
+                board = (engine.stats() or {}).get("board")
+            except (EngineKilled, ConnectionError, OSError,
+                    RuntimeError, AttributeError):
+                board = None
+            if board is not None and tuple(board) != (height, width):
+                raise ValueError(
+                    f"engine torus is {board[1]}x{board[0]} but Params "
+                    f"say {width}x{height} — match the server's "
+                    f"--sparse SIZE")
+
         if key_presses is not None:
             threading.Thread(target=keypress_loop, daemon=True).start()
         threading.Thread(target=ticker_loop, daemon=True).start()
@@ -275,12 +359,22 @@ def distributor(
 
         # -- board source: fresh from PGM, or reattach (`:171-178`) -------
         start_turn = 0
-        if os.environ.get("CONT", "") == "yes":
+        if sparse:
+            if os.environ.get("CONT", "") == "yes":
+                # The engine's held window IS the state; only the resume
+                # arithmetic travels.
+                world = None
+                start_turn = engine.ping()
+                turns_left = max(p.turns - start_turn, 0)
+            else:
+                world = read_pgm(os.path.join(images_dir, "seed.pgm"))
+                turns_left = p.turns
+        elif os.environ.get("CONT", "") == "yes":
             world, start_turn = engine.get_world()
             turns_left = max(p.turns - start_turn, 0)
         else:
             src = input_path(width, height, images_dir)
-            world = read_pgm(src)
+            world = read_pgm(src, levels=pgm_levels)
             if world.shape != (height, width):
                 # A mislabeled file would silently evolve the wrong
                 # geometry under correctly-named outputs — fail here.
@@ -400,8 +494,16 @@ def distributor(
             contacted = True
             try:
                 # Engine is back with authoritative state (it survived, or
-                # was restarted from a checkpoint): resume from it.
-                world, start_turn = engine.get_world()
+                # was restarted from a checkpoint): resume from it. Sparse
+                # runs resume the ENGINE-HELD window (world stays None —
+                # a window snapshot re-stamped as a seed would lose its
+                # torus origin); an engine that came back empty fails the
+                # resubmit with "no sparse state to resume".
+                if sparse:
+                    start_turn = engine.ping()
+                    world = None
+                else:
+                    world, start_turn = engine.get_world()
             except EngineKilled:
                 final_world, final_turn = world, start_turn
                 break
@@ -461,19 +563,56 @@ def distributor(
         # coordinate tuples would exhaust controller memory.
         max_event_cells = env_int(
             "GOL_MAX_EVENT_CELLS", 1 << 24, minimum=0)
-        if final_world.size <= max_event_cells:
-            alive_cells = alive_cells_from_board(final_world)
-            alive = tuple((c.x, c.y) for c in alive_cells)
-            count = len(alive)
+        # The firing mask (pixel == 255): identical to != 0 for life-like
+        # {0,255} boards; for multi-state boards it selects state-1 cells,
+        # matching the engine's AliveCellsCount semantics.
+        if sparse:
+            # Final cells in TORUS coordinates: window nonzeros offset by
+            # the window's torus origin (engine parked — state intact).
+            origin = None
+            try:
+                win, origin, _ = engine.get_window()
+            except (EngineKilled, ConnectionError, OSError, RuntimeError):
+                # State unreachable (engine killed/lost): fall back to
+                # the run's last-known pixels — the torus ORIGIN is lost
+                # with the engine, so only the count travels, never
+                # wrongly-wrapped coordinates.
+                win = final_world
+            if win is None:
+                # Killed before any state on a resume-only run: nothing
+                # to report or write.
+                events_q.put(ev.FinalTurnComplete(final_turn, (), 0))
+                fname = None
+            else:
+                ys, xs = np.nonzero(win)
+                if origin is not None and len(xs) <= max_event_cells:
+                    ox, oy = origin
+                    alive = tuple(
+                        (int((x + ox) % width), int((y + oy) % height))
+                        for x, y in zip(xs, ys))
+                    count = len(alive)
+                else:
+                    alive = ()
+                    count = int(len(xs))
+                events_q.put(ev.FinalTurnComplete(final_turn, alive, count))
+                fname = output_path(
+                    win.shape[1], win.shape[0], final_turn, out_dir)
+                write_pgm(fname, win)
         else:
-            alive = ()
-            count = int((final_world != 0).sum())
-        events_q.put(ev.FinalTurnComplete(final_turn, alive, count))
-        fname = output_path(width, height, final_turn, out_dir)
-        write_pgm(fname, final_world)
-        events_q.put(
-            ev.ImageOutputComplete(final_turn, os.path.basename(fname))
-        )
+            if final_world.size <= max_event_cells:
+                alive_cells = alive_cells_from_board(final_world == 255)
+                alive = tuple((c.x, c.y) for c in alive_cells)
+                count = len(alive)
+            else:
+                alive = ()
+                count = int((final_world == 255).sum())
+            events_q.put(ev.FinalTurnComplete(final_turn, alive, count))
+            fname = output_path(width, height, final_turn, out_dir)
+            write_pgm(fname, final_world, levels=pgm_levels)
+        if fname is not None:
+            events_q.put(
+                ev.ImageOutputComplete(final_turn, os.path.basename(fname))
+            )
         if kp_state["k"]:
             try:
                 engine.kill_prog()

@@ -27,11 +27,12 @@ def run(
     out_dir: Optional[str] = None,
     live_view: bool = False,
     rule=None,
+    sparse: bool = False,
 ) -> threading.Thread:
     def _target() -> None:
         try:
             distributor(p, events, key_presses, engine, images_dir,
-                        out_dir, live_view, rule)
+                        out_dir, live_view, rule, sparse)
         except BaseException as e:
             # Record for callers that need an exit status (the CLI):
             # the thread's traceback alone doesn't reach main()'s

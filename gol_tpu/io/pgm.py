@@ -50,16 +50,23 @@ def _read_token(buf: bytes, pos: int) -> tuple[bytes, int]:
     return buf[start:pos], pos
 
 
-def read_pgm(path: str) -> np.ndarray:
+def read_pgm(path: str, levels=None) -> np.ndarray:
     """Read a P5 PGM into an (H, W) uint8 array of {0, 255}.
 
     Stricter than the reference reader (which indexes `fields[4]` and is
     only safe because GoL payload bytes are never whitespace, `io.go:93-114`):
     this one tokenizes the header properly and then takes exactly W*H
     payload bytes after the single whitespace byte that ends the header.
+
+    `levels`: optional iterable of allowed byte values replacing the
+    strict {0, 255} contract — the multi-state Generations gray encoding
+    (`models/generations.gray_levels`). The native codec hardcodes the
+    2-level contract, so multi-state reads take the Python path.
     """
     from gol_tpu import native
 
+    if levels is not None:
+        return _read_pgm_py(path, tuple(sorted({int(v) for v in levels})))
     try:
         board = native.read_pgm(path)  # single-pass C++ codec when built
     except native.HeaderParseError:
@@ -73,6 +80,10 @@ def read_pgm(path: str) -> np.ndarray:
         board = None
     if board is not None:
         return board
+    return _read_pgm_py(path, (0, MAXVAL))
+
+
+def _read_pgm_py(path: str, allowed: tuple) -> np.ndarray:
     with open(path, "rb") as f:
         buf = f.read()
     magic, pos = _read_token(buf, 0)
@@ -94,30 +105,34 @@ def read_pgm(path: str) -> np.ndarray:
             f"got {len(payload)}"
         )
     board = np.frombuffer(payload, dtype=np.uint8).reshape(height, width)
-    bad = ~np.isin(board, (0, MAXVAL))
+    bad = ~np.isin(board, allowed)
     if bad.any():
-        raise ValueError(f"{path}: {int(bad.sum())} cells not in {{0, 255}}")
+        raise ValueError(
+            f"{path}: {int(bad.sum())} cells not in {set(allowed)}")
     return board.copy()
 
 
-def write_pgm(path: str, board: np.ndarray) -> None:
-    """Write an (H, W) uint8 {0, 255} board as P5 (`io.go:42-85`)."""
+def write_pgm(path: str, board: np.ndarray, levels=None) -> None:
+    """Write an (H, W) uint8 {0, 255} board as P5 (`io.go:42-85`).
+    `levels` relaxes the value contract to a Generations gray-level set
+    (see `read_pgm`); the file format is identical."""
     if board.dtype != np.uint8 or board.ndim != 2:
         raise ValueError(f"board must be 2-D uint8, got {board.dtype} "
                          f"shape {board.shape}")
-    # Validate via two sequential count_nonzero passes: one transient
+    # Validate via sequential count_nonzero passes: one transient
     # bool temporary at a time (~4.3 GB peak on the 65536² finalize path)
     # vs ~13 GB for the combined boolean-mask expression. (bincount would
     # be worse still — numpy casts the input to an 8-byte intp copy.)
-    ok = (np.count_nonzero(board == 0)
-          + np.count_nonzero(board == MAXVAL))
+    allowed = (0, MAXVAL) if levels is None else \
+        tuple(sorted({int(v) for v in levels}))
+    ok = sum(np.count_nonzero(board == v) for v in allowed)
     bad = int(board.size - ok)
     if bad:
         # Fail at the write site — the usual bug is passing the internal
         # {0,1} cells array instead of pixels; writing it would produce a
         # file read_pgm itself rejects, far from the cause.
         raise ValueError(
-            f"{bad} cells not in {{0, {MAXVAL}}} "
+            f"{bad} cells not in {set(allowed)} "
             "(pass pixels, not {0,1} cells)")
     from gol_tpu import native
 

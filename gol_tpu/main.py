@@ -37,35 +37,62 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="enable the live board view (polls snapshots)")
     ap.add_argument("--trace", metavar="DIR", default="",
                     help="dump one jax.profiler chunk trace to DIR")
-    ap.add_argument("--rule", metavar="B.../S...", default="",
-                    help="life-like rulestring for the in-process engine "
-                         "(e.g. B36/S23 = HighLife; default Conway). With "
-                         "SER set, the remote engine's own rule governs.")
+    ap.add_argument("--rule", metavar="RULE", default="",
+                    help="rulestring for the in-process engine: life-like"
+                         " 'B36/S23' (HighLife) or Generations "
+                         "'survival/birth/states' ('/2/3' = Brian's "
+                         "Brain); default Conway. With SER set, the "
+                         "remote engine's own rule governs evolution and "
+                         "this (or GOL_RULE) only sets controller-side "
+                         "io semantics — match the server's --rule.")
     ap.add_argument("--rle", metavar="NAME|FILE", default="",
                     help="seed the board from an RLE pattern instead of "
                          "images/WxH.pgm: a library name (glider, lwss, "
                          "rpentomino, gosper-gun, blinker) or a .rle file, "
                          "stamped centred on an empty WxH torus")
+    ap.add_argument("--sparse", action="store_true",
+                    help="run on the sparse-torus engine: -w/-h give the "
+                         "TORUS size (equal, multiple of 32 — e.g. "
+                         "1048576 = 2^20) and --rle gives the seed; only "
+                         "the live window is ever materialised, snapshots "
+                         "and the final PGM are that window")
     return ap.parse_args(argv)
+
+
+def _parse_rle_arg(name_or_path: str):
+    """(cells, pw, ph, rle_declared_rule_or_None) from a library pattern
+    name or a .rle file path."""
+    from gol_tpu.io.rle import parse_rle, read_rle
+    from gol_tpu.models.patterns import PATTERNS
+
+    if name_or_path in PATTERNS:
+        return parse_rle(PATTERNS[name_or_path])
+    return read_rle(name_or_path)
+
+
+def _stage_tmp_pgm(board, filename: str, prefix: str) -> str:
+    """Write `board` as `<fresh tempdir>/<filename>` (cleaned up at
+    exit); returns the tempdir."""
+    import atexit
+    import os
+    import shutil
+    import tempfile
+
+    from gol_tpu.io.pgm import write_pgm
+
+    d = tempfile.mkdtemp(prefix=prefix)
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
+    write_pgm(os.path.join(d, filename), board)
+    return d
 
 
 def _stage_rle_board(name_or_path: str, width: int, height: int):
     """Stamp an RLE pattern (library name or file path) centred on an
     empty width x height board and write it as `WxH.pgm` in a fresh temp
     images dir. Returns (images_dir, rle_declared_rule_or_None)."""
-    import os
-    import tempfile
-
     import numpy as np
 
-    from gol_tpu.io.pgm import input_path, write_pgm
-    from gol_tpu.io.rle import parse_rle, read_rle
-    from gol_tpu.models.patterns import PATTERNS
-
-    if name_or_path in PATTERNS:
-        cells, pw, ph, rle_rule = parse_rle(PATTERNS[name_or_path])
-    else:
-        cells, pw, ph, rle_rule = read_rle(name_or_path)
+    cells, pw, ph, rle_rule = _parse_rle_arg(name_or_path)
     if pw > width or ph > height:
         raise ValueError(
             f"pattern extent {pw}x{ph} exceeds board {width}x{height}")
@@ -73,13 +100,22 @@ def _stage_rle_board(name_or_path: str, width: int, height: int):
     ox, oy = (width - pw) // 2, (height - ph) // 2
     for x, y in cells:
         board[oy + y, ox + x] = 255
-    d = tempfile.mkdtemp(prefix="gol_rle_")
-    import atexit
-    import shutil
+    return (_stage_tmp_pgm(board, f"{width}x{height}.pgm", "gol_rle_"),
+            rle_rule)
 
-    atexit.register(shutil.rmtree, d, ignore_errors=True)
-    write_pgm(input_path(width, height, d), board)
-    return d, rle_rule
+
+def _stage_sparse_seed(name_or_path: str):
+    """Write an RLE pattern as `<tempdir>/seed.pgm` at its own bounding-
+    box dims — the sparse distributor's board source (live cells are
+    stamped centred on the torus engine-side). Returns (images_dir,
+    rle_declared_rule_or_None)."""
+    import numpy as np
+
+    cells, pw, ph, rle_rule = _parse_rle_arg(name_or_path)
+    board = np.zeros((ph, pw), dtype=np.uint8)
+    for x, y in cells:
+        board[y, x] = 255
+    return _stage_tmp_pgm(board, "seed.pgm", "gol_sparse_"), rle_rule
 
 
 def main(argv=None) -> int:
@@ -95,9 +131,9 @@ def main(argv=None) -> int:
         os.environ[TRACE_ENV] = args.trace
     rule = None
     if args.rule:
-        from gol_tpu.models.lifelike import LifeLikeRule
+        from gol_tpu.models import parse_rule
 
-        rule = LifeLikeRule(args.rule)  # fail fast on a malformed string
+        rule = parse_rule(args.rule)  # fail fast on a malformed string
         if os.environ.get("SER"):
             import warnings
 
@@ -112,7 +148,20 @@ def main(argv=None) -> int:
         turns=args.turns,
     )
     images_dir = None
-    if args.rle:
+    if args.sparse:
+        if not args.rle and os.environ.get("CONT", "") != "yes":
+            print("--sparse needs --rle (the seed pattern) unless "
+                  "CONT=yes resumes an engine-held run", file=sys.stderr)
+            return 2
+        if args.width != args.height or args.width % 32:
+            print(f"--sparse torus must be square and a multiple of 32, "
+                  f"got {args.width}x{args.height}", file=sys.stderr)
+            return 2
+        if args.rle:
+            images_dir, rle_rule = _stage_sparse_seed(args.rle)
+            if rule is None:
+                rule = rle_rule
+    elif args.rle:
         # Materialise the pattern as the WxH.pgm the distributor expects
         # (in a temp images dir) — the PGM board-source contract stays the
         # single entry path. An RLE-declared rule applies unless --rule
@@ -131,7 +180,7 @@ def main(argv=None) -> int:
     events_q: "queue.Queue" = queue.Queue(maxsize=10000)
     key_presses: "queue.Queue" = queue.Queue(maxsize=10)
     t = run(p, events_q, key_presses, live_view=args.live, rule=rule,
-            images_dir=images_dir)
+            images_dir=images_dir, sparse=args.sparse)
     view_start(p, events_q, key_presses, headless=args.headless)
     t.join(30)
     if t.exception is not None:

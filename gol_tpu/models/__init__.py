@@ -14,6 +14,25 @@ from gol_tpu.models.lifelike import (
 from gol_tpu.models.patterns import PATTERNS, pattern_cells, stamp
 from gol_tpu.models.sparse import R_PENTOMINO, SparseTorus
 
+
+def parse_rule(rulestring: str):
+    """Parse a rulestring into its family's rule object: 'B3/S23'-style
+    → LifeLikeRule; 'survival/birth/states' ('/2/3' = Brian's Brain) →
+    GenerationsRule. Empty → Conway. The single dispatch point for every
+    rule-accepting surface (CLI --rule, server --rule, GOL_RULE)."""
+    if not rulestring:
+        return CONWAY
+    errors = []
+    for family in (LifeLikeRule, GenerationsRule):
+        try:
+            return family(rulestring)
+        except ValueError as e:
+            errors.append(str(e))
+    raise ValueError(
+        f"unrecognised rulestring {rulestring!r}: not life-like "
+        "('B3/S23') nor Generations ('survival/birth/states', e.g. "
+        f"'/2/3'). Family errors: {'; '.join(errors)}")
+
 __all__ = [
     "BRIANS_BRAIN",
     "CONWAY",
@@ -27,6 +46,7 @@ __all__ = [
     "GenerationsTorus",
     "LifeLikeRule",
     "SparseTorus",
+    "parse_rule",
     "pattern_cells",
     "stamp",
 ]

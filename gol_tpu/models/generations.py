@@ -74,26 +74,70 @@ BRIANS_BRAIN = GenerationsRule("/2/3")
 STAR_WARS = GenerationsRule("345/2/4")
 
 
-def _step(state: jax.Array, rule: GenerationsRule) -> jax.Array:
-    """One torus turn of a (H, W) uint8 state board."""
-    alive = (state == 1).astype(jnp.uint8)
-    vert = (jnp.roll(alive, 1, axis=0) + alive
-            + jnp.roll(alive, -1, axis=0))
-    n = (vert + jnp.roll(vert, 1, axis=1) + jnp.roll(vert, -1, axis=1)
-         - alive)  # 8-neighbour count of ALIVE cells
+# ------------------------------------------------------- pixel encoding
+#
+# Multi-state snapshot/PGM encoding (full-stack contract, r4): dead = 0,
+# alive (state 1) = 255 — so a standard {0,255} life PGM seeds alive
+# cells, and for C == 2 the format degenerates to the reference's
+# byte-exact encoding (`io.go:109-111`) — and dying states fade from
+# bright toward black as they age: gray(s) = 255 - (s-1)*255 // (C-1)
+# for s >= 2. Levels are strictly distinct for every C <= 256, so the
+# mapping round-trips exactly through P5 files and `get_world`
+# snapshots.
+
+
+def gray_levels(rule: GenerationsRule) -> np.ndarray:
+    """(states,) uint8: the gray value encoding each state."""
+    c = rule.states
+    levels = np.zeros(c, dtype=np.uint8)
+    levels[1] = 255
+    for s in range(2, c):
+        levels[s] = 255 - ((s - 1) * 255) // (c - 1)
+    return levels
+
+
+def to_pixels_gen(state: np.ndarray, rule: GenerationsRule) -> np.ndarray:
+    """uint8 state board -> gray pixel board (host-side)."""
+    return gray_levels(rule)[np.asarray(state)]
+
+
+def from_pixels_gen(pixels: np.ndarray, rule: GenerationsRule) -> np.ndarray:
+    """Gray pixel board -> uint8 state board; rejects gray values that
+    encode no state (a corrupt or foreign-rule file would otherwise
+    seed silently-wrong states)."""
+    levels = gray_levels(rule)
+    inverse = np.full(256, 255, dtype=np.uint8)  # 255 = invalid marker
+    inverse[levels] = np.arange(rule.states, dtype=np.uint8)
+    state = inverse[np.asarray(pixels, dtype=np.uint8)]
+    bad = (state == 255) if rule.states <= 255 else np.zeros(1, bool)
+    if bad.any():
+        vals = sorted(set(np.asarray(pixels)[bad].tolist()))[:8]
+        raise ValueError(
+            f"pixels contain gray values {vals} that encode no state of "
+            f"{rule.rulestring} (levels: {levels.tolist()})")
+    return state
+
+
+def apply_generations_rule(
+    state: jax.Array, n: jax.Array, rule: GenerationsRule
+) -> jax.Array:
+    """The Generations transition given the 8-neighbour ALIVE counts `n`:
+    dead -> 1 if born; alive -> 1 if surviving else first dying state
+    (which for C == 2 IS death); dying -> next state, death after C-1.
+    Shared by the single-device kernel and the sharded halo kernel
+    (`parallel/halo._gen_local_step`).
+
+    Equality form stays entirely in uint8 — the naive `state + 1 < c`
+    breaks at c == 256 (a uint8 `state + 1` wraps 255 -> 0 and
+    `anything < 256` is always false, killing every dying cell after
+    one turn). Valid states are < c, so `state + 1` in the taken
+    branch never wraps."""
     born_lut = jnp.array(
         [1 if i in rule.born else 0 for i in range(9)], dtype=jnp.uint8)
     surv_lut = jnp.array(
         [1 if i in rule.survive else 0 for i in range(9)],
         dtype=jnp.uint8)
     c = rule.states
-    # dead -> 1 if born; alive -> 1 if surviving else first dying state
-    # (which for C == 2 IS death); dying -> next state, death after C-1.
-    # Equality form stays entirely in uint8 — the naive `state + 1 < c`
-    # breaks at c == 256 (a uint8 `state + 1` wraps 255 -> 0 and
-    # `anything < 256` is always false, killing every dying cell after
-    # one turn). Valid states are < c, so `state + 1` in the taken
-    # branch never wraps.
     dying_next = jnp.where(
         state == c - 1, jnp.uint8(0), state + 1).astype(jnp.uint8)
     out = jnp.where(
@@ -107,6 +151,24 @@ def _step(state: jax.Array, rule: GenerationsRule) -> jax.Array:
         ),
     )
     return out.astype(jnp.uint8)
+
+
+def state_alive_count(state) -> int:
+    """Cells in state 1 (the firing population) of a uint8 state board.
+    Per-row int32 sums, final sum in host int64 — a flat int32 reduction
+    would wrap past 2^31 firing cells on giant boards."""
+    rows = jnp.sum((state == 1).astype(jnp.int32), axis=-1)
+    return int(np.asarray(jax.device_get(rows), dtype=np.int64).sum())
+
+
+def _step(state: jax.Array, rule: GenerationsRule) -> jax.Array:
+    """One torus turn of a (H, W) uint8 state board."""
+    alive = (state == 1).astype(jnp.uint8)
+    vert = (jnp.roll(alive, 1, axis=0) + alive
+            + jnp.roll(alive, -1, axis=0))
+    n = (vert + jnp.roll(vert, 1, axis=1) + jnp.roll(vert, -1, axis=1)
+         - alive)  # 8-neighbour count of ALIVE cells
+    return apply_generations_rule(state, n, rule)
 
 
 @functools.partial(jax.jit, static_argnames=("num_turns", "rule"))
@@ -209,8 +271,4 @@ class GenerationsTorus:
             from gol_tpu.ops.bitpack import packed_alive_count
 
             return packed_alive_count(self._a)
-        # Per-row int32 sums, final sum in host int64 — a flat int32
-        # reduction would wrap past 2^31 firing cells on giant boards.
-        rows = jnp.sum((self._state == 1).astype(jnp.int32), axis=-1)
-        return int(np.asarray(jax.device_get(rows),
-                              dtype=np.int64).sum())
+        return state_alive_count(self._state)

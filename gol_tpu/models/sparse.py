@@ -174,6 +174,30 @@ class SparseTorus:
         self._margins_host: Optional[Tuple[int, int, int, int]] = None
         self._margins_valid = False
 
+    @classmethod
+    def _from_state(
+        cls,
+        size: int,
+        words: np.ndarray,
+        ox: int,
+        oy: int,
+        rule: LifeLikeRule = CONWAY,
+    ) -> "SparseTorus":
+        """Rebuild a torus from checkpointed window state (packed words +
+        torus origin) without re-deriving it from a cell list — the
+        restore half of `SparseEngine.save_checkpoint`."""
+        self = cls.__new__(cls)
+        self.size = size
+        self.rule = rule
+        self.turn = 0
+        self._ox = ox % size
+        self._oy = oy % size
+        self._packed = jax.device_put(np.asarray(words, dtype=np.uint32))
+        self._occ = None
+        self._margins_host = None
+        self._margins_valid = False
+        return self
+
     # ------------------------------------------------------------- queries
 
     def alive_count(self) -> int:

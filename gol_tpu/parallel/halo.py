@@ -336,6 +336,116 @@ def sharded_packed_run_turns(
         packed, num_turns)
 
 
+# ------------------------------------------------------- Generations
+#
+# The multi-state family rides the SAME shard_map + ppermute machinery:
+# a Generations turn only needs 1-row halos of the state board (neighbour
+# counts are of the ALIVE plane, derived locally from the haloed state).
+# Three-state rules on 32-aligned widths additionally get the bit-packed
+# two-plane kernel (alive/dying planes stacked as one (2, rows, Wp)
+# uint32 array so the engine's single-array state plumbing — checkpoint,
+# token, publication — applies unchanged).
+
+
+def _gen_local_step(local: jax.Array, n_shards: int, rule):
+    """One Generations turn of one uint8 state shard."""
+    from gol_tpu.models.generations import apply_generations_rule
+
+    top, bot = _exchange_row_halos(local, n_shards)
+    padded = jnp.concatenate([top, local, bot], axis=0)
+    alive = (padded == 1).astype(jnp.uint8)
+    vert = alive[:-2, :] + alive[1:-1, :] + alive[2:, :]
+    n = (vert + jnp.roll(vert, 1, axis=1) + jnp.roll(vert, -1, axis=1)
+         - alive[1:-1, :])
+    return apply_generations_rule(local, n, rule)
+
+
+def sharded_generations_run_turns(
+    state: jax.Array, num_turns: int, mesh: Mesh, rule
+) -> jax.Array:
+    """Advance a row-sharded uint8 Generations state board."""
+    return _make_compiled_run(mesh, rule, _gen_local_step)(state, num_turns)
+
+
+def gen3_planes_sharding(mesh: Mesh):
+    """Sharding for the stacked (2, rows, Wp) two-plane state: rows over
+    the mesh, plane and word axes replicated within a shard."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, P(None, ROWS_AXIS, None))
+
+
+def shard_board_gen3(stacked: jax.Array, mesh: Mesh) -> jax.Array:
+    return jax.device_put(stacked, gen3_planes_sharding(mesh))
+
+
+def _gen3_local_step(stacked: jax.Array, n_shards: int, rule):
+    """One turn of one shard of the stacked packed planes: halo-exchange
+    the ALIVE plane's edge rows (the dying plane transitions locally),
+    then the carry-save adder network + two-plane rule from
+    `models/generations.packed_run_turns3`."""
+    from gol_tpu.ops.bitpack import neighbour_count_bits, rule_masks
+
+    a, d = stacked[0], stacked[1]
+    top, bot = _exchange_row_halos(a, n_shards)
+    padded = jnp.concatenate([top, a, bot], axis=0)
+    n0, n1, n2, n3 = neighbour_count_bits(
+        padded[:-2, :], a, padded[2:, :])
+    born, surv = rule_masks(n0, n1, n2, n3, rule.born, rule.survive)
+    a2 = (~a & ~d & born) | (a & surv)
+    d2 = a & ~surv
+    return jnp.stack([a2, d2])
+
+
+@functools.lru_cache(maxsize=64)
+def _make_compiled_gen3_run(mesh: Mesh, rule):
+    n_shards = mesh.shape[ROWS_AXIS]
+    spec = P(None, ROWS_AXIS, None)
+
+    @functools.partial(jax.jit, static_argnames=("num_turns",))
+    def run(stacked: jax.Array, num_turns: int) -> jax.Array:
+        if num_turns == 0:
+            return stacked
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=spec, out_specs=spec
+        )
+        def run_local(local):
+            def body(s, _):
+                return _gen3_local_step(s, n_shards, rule), None
+            out, _ = lax.scan(body, local, None, length=num_turns)
+            return out
+
+        return run_local(stacked)
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _gen3_single_run(rule):
+    """Cached jit of the single-shard stacked-planes run (a fresh closure
+    per call would re-trace/compile every chunk)."""
+    from gol_tpu.models.generations import packed_run_turns3
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def run1(s, k):
+        a, d = packed_run_turns3(s[0], s[1], k, rule)
+        return jnp.stack([a, d])
+
+    return run1
+
+
+def sharded_gen3_run_turns(
+    stacked: jax.Array, num_turns: int, mesh: Mesh, rule
+) -> jax.Array:
+    """Advance stacked packed (alive, dying) planes of a 3-state rule.
+    Single-shard meshes use the roll-based two-plane scan directly (no
+    shard_map wrapper — same fast-path policy as the life-like board)."""
+    if mesh.shape[ROWS_AXIS] == 1:
+        return _gen3_single_run(rule)(stacked, num_turns)
+    return _make_compiled_gen3_run(mesh, rule)(stacked, num_turns)
+
+
 def select_representation(width: int):
     """The one place the packed-eligibility rule lives: returns
     (packed: bool, run_fn) — bit-packed whenever the width is a whole

@@ -151,6 +151,11 @@ class EngineServer:
             elif method == "GetWorld":
                 out, turn = self.engine.get_world()
                 send_msg(conn, {"ok": True, "turn": turn}, out)
+            elif method == "GetWindow":
+                # Sparse engines only: live-window pixels + torus origin.
+                out, (ox, oy), turn = self.engine.get_window()
+                send_msg(conn, {"ok": True, "turn": turn,
+                                "ox": ox, "oy": oy}, out)
             elif method == "CFput":
                 self.engine.cf_put(int(header["flag"]))
                 send_msg(conn, {"ok": True})
@@ -189,10 +194,18 @@ def main() -> None:
                     help="multi-host engine: jax.distributed coordinator "
                          "address (falls back to GOL_COORDINATOR; unset = "
                          "single-host)")
-    ap.add_argument("--rule", metavar="B.../S...",
+    ap.add_argument("--rule", metavar="RULE",
                     default=os.environ.get("GOL_RULE") or "B3/S23",
-                    help="life-like rulestring this engine evolves "
-                         "(default Conway; falls back to GOL_RULE)")
+                    help="rulestring this engine evolves: life-like "
+                         "'B3/S23' or Generations 'survival/birth/states'"
+                         " (e.g. '/2/3' = Brian's Brain; default Conway; "
+                         "falls back to GOL_RULE)")
+    ap.add_argument("--sparse", metavar="SIZE", type=int, default=0,
+                    help="serve a sparse-torus engine of SIZE x SIZE "
+                         "cells (SIZE %% 32 == 0, e.g. 1048576 = 2^20) "
+                         "instead of the dense engine: runs are seeded "
+                         "by a small pattern board, snapshots are the "
+                         "live window (life-like rules only)")
     args = ap.parse_args()
     # Join the multi-host engine cluster FIRST: jax.distributed must
     # initialize before ANYTHING touches the XLA backend (including the
@@ -208,10 +221,16 @@ def main() -> None:
     import gol_tpu
 
     gol_tpu.maybe_enable_default_compile_cache()
-    from gol_tpu.models.lifelike import LifeLikeRule
+    from gol_tpu.models import parse_rule
 
-    srv = EngineServer(port=args.port, host=args.host,
-                       engine=Engine(rule=LifeLikeRule(args.rule)))
+    rule = parse_rule(args.rule)
+    if args.sparse:
+        from gol_tpu.sparse_engine import SparseEngine
+
+        eng = SparseEngine(args.sparse, rule=rule)
+    else:
+        eng = Engine(rule=rule)
+    srv = EngineServer(port=args.port, host=args.host, engine=eng)
     if args.resume:
         turn = srv.engine.load_checkpoint(args.resume)
         print(f"restored checkpoint {args.resume} at turn {turn}")

@@ -1,0 +1,364 @@
+"""SparseEngine: the sparse-torus model behind the FULL control protocol.
+
+r4 (VERDICT r3 weak #5/"next" #6): `models/sparse.SparseTorus` is the
+kernel — episode-batched macro-steps over the live window of a 2^40-cell
+torus — and this wraps it in the same 5-method-plus control surface as
+the dense `Engine` (duck-typed: `server.EngineServer`, `client
+.RemoteEngine` and the distributor drive either without caring which):
+
+    server_distributor — blocking run; `world` is a SMALL seed board
+                         whose live cells are stamped centred on the
+                         torus, or None to resume the engine-held state
+                         (the CONT=yes detach/reattach contract)
+    alive_count        — (firing count, turn), published per chunk with
+                         no device work on the poll path
+    get_world          — live-window snapshot ({0,255} pixels)
+    get_window         — (pixels, (ox, oy) torus origin, turn)
+    cf_put/drain_flags — reference flag protocol (pause 0/quit 2/kill 5)
+    abort_run/ping/stats/kill_prog, save/load_checkpoint
+
+Chunking: the host wakes between adaptively-sized turn chunks (wall
+target ~CHUNK_TARGET_SECONDS, same band discipline as the dense engine)
+to honour flags and publish (window, origin, turn, alive) as one
+coherent snapshot; inside a chunk `SparseTorus.run` batches macro-steps
+into synchronization-free episodes.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from gol_tpu.engine import (
+    CHUNK_TARGET_ENV,
+    CHUNK_TARGET_SECONDS,
+    CKPT_ENV,
+    CKPT_EVERY_DEFAULT,
+    CKPT_EVERY_ENV,
+    FLAG_KILL,
+    FLAG_PAUSE,
+    FLAG_QUIT,
+    MAX_CHUNK_ENV,
+    EngineBusy,
+    EngineKilled,
+)
+from gol_tpu.models.lifelike import CONWAY
+from gol_tpu.models.sparse import SparseTorus
+from gol_tpu.ops.bitpack import WORD_BITS, unpack
+from gol_tpu.utils.envcfg import env_float, env_int
+
+SPARSE_CHUNK_MIN = 64
+SPARSE_CHUNK_MAX = 1 << 16
+
+
+class SparseEngine:
+    def __init__(self, size: int, rule=CONWAY) -> None:
+        from gol_tpu.models.lifelike import LifeLikeRule
+
+        if not isinstance(rule, LifeLikeRule):
+            # The live-window argument (sparse.py module doc) is a
+            # 2-state property; a Generations rule would evolve wrongly.
+            raise ValueError(
+                f"sparse engine supports life-like rules only, "
+                f"got {rule.rulestring!r}")
+        if size % WORD_BITS != 0:
+            raise ValueError(f"torus size {size} not a multiple of 32")
+        self.size = size
+        self._rule = rule
+        self._state_lock = threading.Lock()
+        self._torus: Optional[SparseTorus] = None
+        self._turn = 0
+        # Published-per-chunk coherent snapshot: (packed handle, ox, oy,
+        # turn, alive). Poll paths (ticker, get_window, checkpoint) read
+        # this, never the mutating torus internals.
+        self._pub: Optional[tuple] = None
+        self._flags: "queue.Queue[int]" = queue.Queue()
+        self._killed = False
+        self._running = False
+        self._run_token: Optional[str] = None
+        self._abort = threading.Event()
+        self._last_chunk = 0
+        self._turns_per_s = 0.0
+
+    # ------------------------------------------------------------------ RPC
+
+    def server_distributor(
+        self,
+        params,
+        world: Optional[np.ndarray],
+        sub_workers: Sequence[str] = (),
+        start_turn: int = 0,
+        token: Optional[str] = None,
+    ) -> Tuple[np.ndarray, int]:
+        """Blocking sparse run. `world`: small seed board (its live cells
+        are stamped centred on the torus) or None to resume the held
+        state. Returns ({0,255} live-window pixels, completed turn)."""
+        self._check_alive()
+        if self._running:
+            raise EngineBusy("engine already running a board")
+        if world is not None:
+            h0, w0 = world.shape
+            ys, xs = np.nonzero(world)
+            if len(xs) == 0:
+                raise ValueError("seed board has no live cells")
+            offx = (self.size - w0) // 2
+            offy = (self.size - h0) // 2
+            cells = [(int(x) + offx, int(y) + offy)
+                     for x, y in zip(xs, ys)]
+            torus = SparseTorus(self.size, cells, self._rule)
+        else:
+            torus = None
+        with self._state_lock:
+            if self._running:
+                raise EngineBusy("engine already running a board")
+            if torus is not None:
+                self._torus = torus
+                self._turn = start_turn
+            elif self._torus is None:
+                raise RuntimeError("no sparse state to resume")
+            self._running = True
+            self._run_token = token
+            self._abort.clear()
+            self._publish_locked()
+
+        target = start_turn + params.turns
+        chunk_target = (env_float(CHUNK_TARGET_ENV, CHUNK_TARGET_SECONDS)
+                        or CHUNK_TARGET_SECONDS)
+        # GOL_MAX_CHUNK bounds flag/pause latency exactly as on the
+        # dense engine (and is the tests' throttle).
+        max_chunk = min(env_int(MAX_CHUNK_ENV, SPARSE_CHUNK_MAX),
+                        SPARSE_CHUNK_MAX)
+        chunk = min(SPARSE_CHUNK_MIN, max_chunk)
+        quit_run = False
+        ckpt_dir = os.environ.get(CKPT_ENV, "")
+        ckpt_every = env_float(CKPT_EVERY_ENV, CKPT_EVERY_DEFAULT)
+        ckpt_path = ""
+        if ckpt_dir:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            ckpt_path = os.path.join(
+                ckpt_dir, f"sparse{self.size}x{self.size}.npz")
+        last_ckpt = time.monotonic()
+        try:
+            while self._turn < target and not quit_run:
+                if self._killed or self._abort.is_set():
+                    break
+                k = min(chunk, target - self._turn)
+                t0 = time.monotonic()
+                self._torus.run(k)
+                # One poll-free (alive, turn) pair per chunk; fetching the
+                # count also syncs the episode chain, making `elapsed` a
+                # real wall measurement for the chunk adapter.
+                alive = self._torus.alive_count()
+                elapsed = time.monotonic() - t0
+                with self._state_lock:
+                    self._turn += k
+                    self._last_chunk = k
+                    if elapsed > 0:
+                        self._turns_per_s = k / elapsed
+                    self._publish_locked(alive)
+                if elapsed < chunk_target and chunk * 2 <= max_chunk:
+                    chunk *= 2
+                elif elapsed > chunk_target * 2 and chunk > 1:
+                    chunk //= 2
+                if ckpt_path and \
+                        time.monotonic() - last_ckpt >= ckpt_every:
+                    self.save_checkpoint(ckpt_path)
+                    last_ckpt = time.monotonic()
+                if self._turn < target:
+                    quit_run = self._handle_flags()
+        finally:
+            with self._state_lock:
+                final_pub = self._pub
+                final_turn = self._turn
+                self._running = False
+                self._run_token = None
+                self._abort.clear()
+        return self._window_pixels(final_pub), final_turn
+
+    def alive_count(self) -> Tuple[int, int]:
+        """(firing count, turn) from the last published chunk boundary —
+        exact-at-turn, no device work on the poll path."""
+        self._check_alive()
+        with self._state_lock:
+            if self._pub is None:
+                return 0, self._turn
+            _, _, _, turn, alive = self._pub
+            return alive, turn
+
+    def get_world(self) -> Tuple[np.ndarray, int]:
+        """Live-window snapshot pixels (the sparse analog of the full
+        board — the torus itself is up to 2^40 cells)."""
+        pixels, _, turn = self.get_window()
+        return pixels, turn
+
+    def get_window(self) -> Tuple[np.ndarray, Tuple[int, int], int]:
+        """(window pixels, (ox, oy) torus origin of window cell (0,0),
+        completed turn)."""
+        self._check_alive()
+        with self._state_lock:
+            pub = self._pub
+            turn = self._turn
+        if pub is None:
+            raise RuntimeError("no board loaded")
+        return self._window_pixels(pub), (pub[1], pub[2]), pub[3]
+
+    def cf_put(self, flag: int) -> None:
+        self._check_alive()
+        if flag not in (FLAG_PAUSE, FLAG_QUIT, FLAG_KILL):
+            raise ValueError(f"unknown control flag {flag}")
+        self._flags.put(flag)
+
+    def drain_flags(self, pause_only: bool = False) -> None:
+        self._check_alive()
+        with self._state_lock:
+            if self._running:
+                return
+            kept = []
+            try:
+                while True:
+                    flag = self._flags.get_nowait()
+                    if pause_only and flag != FLAG_PAUSE:
+                        kept.append(flag)
+            except queue.Empty:
+                pass
+            for flag in kept:
+                self._flags.put(flag)
+
+    def kill_prog(self) -> None:
+        self._killed = True
+
+    def abort_run(self, token: Optional[str] = None) -> bool:
+        self._check_alive()
+        with self._state_lock:
+            if (token is not None and self._running
+                    and self._run_token == token):
+                self._abort.set()
+                return True
+            return False
+
+    def ping(self) -> int:
+        self._check_alive()
+        with self._state_lock:
+            return self._turn
+
+    def stats(self) -> dict:
+        self._check_alive()
+        with self._state_lock:
+            window = origin = None
+            if self._pub is not None:
+                packed, ox, oy, _, _ = self._pub
+                h, wp = packed.shape
+                window, origin = [h, wp * WORD_BITS], [ox, oy]
+            return {
+                "turn": self._turn,
+                "running": self._running,
+                "board": [self.size, self.size],
+                "window": window,
+                "origin": origin,
+                "packed": True,
+                "sparse": True,
+                "chunk": self._last_chunk,
+                "turns_per_s": round(self._turns_per_s, 1),
+                "rule": self._rule.rulestring,
+                "devices": 1,
+            }
+
+    # -------------------------------------------------------- checkpointing
+
+    def save_checkpoint(self, path: str) -> None:
+        """Atomic .npz of (window words, origin, torus size, turn,
+        rule) — the whole sparse state, 8 cells/byte."""
+        with self._state_lock:
+            pub = self._pub
+        if pub is None:
+            raise RuntimeError("no board loaded")
+        packed, ox, oy, turn, _ = pub
+        words = np.asarray(jax.device_get(packed))
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f, sparse_words=words, ox=ox, oy=oy,
+                    size=self.size, turn=turn,
+                    rulestring=self._rule.rulestring)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def load_checkpoint(self, path: str) -> int:
+        self._check_alive()
+        with np.load(path) as z:
+            if "sparse_words" not in z.files:
+                raise ValueError(f"{path}: not a sparse checkpoint")
+            if str(z["rulestring"]) != self._rule.rulestring:
+                raise ValueError(
+                    f"checkpoint rule {z['rulestring']!r} != engine rule "
+                    f"{self._rule.rulestring!r}")
+            if int(z["size"]) != self.size:
+                raise ValueError(
+                    f"checkpoint torus {int(z['size'])} != engine torus "
+                    f"{self.size}")
+            words = z["sparse_words"]
+            if words.dtype != np.uint32 or words.ndim != 2:
+                raise ValueError(f"{path}: bad words {words.dtype} "
+                                 f"{words.shape}")
+            torus = SparseTorus._from_state(
+                self.size, words, int(z["ox"]), int(z["oy"]), self._rule)
+            turn = int(z["turn"])
+        with self._state_lock:
+            if self._running:
+                raise RuntimeError("cannot restore while running")
+            self._torus = torus
+            self._turn = turn
+            self._publish_locked()
+        return turn
+
+    # ------------------------------------------------------------- internals
+
+    def _check_alive(self) -> None:
+        if self._killed:
+            raise EngineKilled("engine has been killed")
+
+    def _publish_locked(self, alive: Optional[int] = None) -> None:
+        """Refresh the coherent poll snapshot; caller holds the lock."""
+        t = self._torus
+        if t is None:
+            self._pub = None
+            return
+        if alive is None:
+            alive = t.alive_count()
+        self._pub = (t._packed, t._ox, t._oy, self._turn, alive)
+
+    @staticmethod
+    def _window_pixels(pub) -> np.ndarray:
+        if pub is None:
+            raise RuntimeError("no board loaded")
+        return (np.asarray(jax.device_get(unpack(pub[0])))
+                * np.uint8(255))
+
+    def _handle_flags(self) -> bool:
+        """Identical semantics to the dense engine's flag drain."""
+        paused = False
+        while True:
+            if self._killed or self._abort.is_set():
+                return True
+            try:
+                flag = self._flags.get_nowait() if not paused \
+                    else self._flags.get(timeout=0.05)
+            except queue.Empty:
+                if not paused:
+                    return False
+                continue
+            if flag == FLAG_PAUSE:
+                paused = not paused
+                if not paused:
+                    return False
+            elif flag in (FLAG_QUIT, FLAG_KILL):
+                return True

@@ -1,0 +1,320 @@
+"""Sparse engine behind the control protocol (r4 — VERDICT r3 "next"
+#6): an R-pentomino on a 2^20 torus emits AliveCellsCount events, obeys
+pause/snapshot/quit, survives a detach/reattach cycle and checkpoints —
+all through the same distributor/server stack as the dense engine."""
+
+import os
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import Params, events as ev, run
+from gol_tpu.engine import FLAG_PAUSE, FLAG_QUIT, EngineKilled
+from gol_tpu.io.pgm import read_pgm, write_pgm
+from gol_tpu.models.sparse import R_PENTOMINO, SparseTorus
+from gol_tpu.sparse_engine import SparseEngine
+
+SIZE = 2**20
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ("SER", "CONT", "SUB", "GOL_RULE"):
+        monkeypatch.delenv(k, raising=False)
+
+
+def _seed_dir(tmp_path):
+    """R-pentomino staged as the sparse seed board."""
+    d = tmp_path / "images"
+    d.mkdir()
+    board = np.zeros((3, 3), dtype=np.uint8)
+    for x, y in R_PENTOMINO:
+        board[y, x] = 255
+    write_pgm(str(d / "seed.pgm"), board)
+    return str(d)
+
+
+def _oracle(turns):
+    """Independent replay: the R-pentomino seeded exactly like the engine
+    (seed board (3,3) stamped centred: offset (SIZE-3)//2)."""
+    off = (SIZE - 3) // 2
+    t = SparseTorus(SIZE, [(x + off, y + off) for x, y in R_PENTOMINO])
+    t.run(turns)
+    return t
+
+
+def test_sparse_engine_run_and_queries():
+    eng = SparseEngine(SIZE)
+    seed = np.zeros((3, 3), dtype=np.uint8)
+    for x, y in R_PENTOMINO:
+        seed[y, x] = 255
+    p = Params(threads=1, image_width=SIZE, image_height=SIZE, turns=200)
+    win, turn = eng.server_distributor(p, seed)
+    assert turn == 200
+    want = _oracle(200)
+    assert eng.alive_count() == (want.alive_count(), 200)
+    # torus-coordinate parity via the window origin
+    pix, (ox, oy), turn2 = eng.get_window()
+    assert turn2 == 200
+    ys, xs = np.nonzero(pix)
+    got = {(int((x + ox) % SIZE), int((y + oy) % SIZE))
+           for x, y in zip(xs, ys)}
+    assert got == set(want.alive_cells())
+    st = eng.stats()
+    assert st["sparse"] and st["board"] == [SIZE, SIZE]
+    assert st["rule"] == "B3/S23" and st["window"] == list(pix.shape)
+
+
+def test_sparse_full_stack_ticker_pause_snapshot_quit(
+        tmp_path, out_dir, monkeypatch):
+    # Throttle so flag latency is chunk-bounded and the pause-quiescence
+    # detection below can't mistake a long chunk for a parked engine.
+    monkeypatch.setenv("GOL_MAX_CHUNK", "64")
+    images_dir = _seed_dir(tmp_path)
+    engine = SparseEngine(SIZE)
+    p = Params(threads=1, image_width=SIZE, image_height=SIZE,
+               turns=10**8)
+    events_q, keys = queue.Queue(), queue.Queue()
+    run(p, events_q, keys, engine=engine,
+        images_dir=images_dir, out_dir=out_dir, sparse=True)
+
+    # ticker: AliveCellsCount within the 5 s first-event contract margin
+    tick = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and tick is None:
+        try:
+            e = events_q.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        if isinstance(e, ev.AliveCellsCount):
+            tick = e
+    assert tick is not None, "sparse run emitted no AliveCellsCount"
+    want = _oracle(tick.completed_turns)
+    assert tick.cells_count == want.alive_count()
+
+    # Let the run get past the first-chunk compile before pausing — at
+    # turn 0 the quiescence detection below would false-positive on the
+    # not-yet-started engine.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if engine.alive_count()[1] > 0:
+            break
+        time.sleep(0.2)
+
+    # pause parks the turn counter
+    keys.put("p")
+    deadline = time.monotonic() + 60
+    _, t1 = engine.alive_count()
+    while time.monotonic() < deadline:
+        time.sleep(0.4)
+        _, t = engine.alive_count()
+        if t == t1:
+            break
+        t1 = t
+    time.sleep(1.0)
+    _, t2 = engine.alive_count()
+    assert t1 == t2, "turn advanced while paused"
+    keys.put("p")
+
+    # snapshot: the live window, named by WINDOW dims
+    keys.put("s")
+    snap = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and snap is None:
+        try:
+            e = events_q.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        if isinstance(e, ev.ImageOutputComplete):
+            snap = e
+    assert snap is not None
+    board = read_pgm(os.path.join(out_dir, snap.filename))
+    assert board.shape[0] < SIZE  # a window, not the torus
+    want = _oracle(snap.completed_turns)
+    assert int((board != 0).sum()) == want.alive_count()
+
+    keys.put("q")
+    evs = ev.drain(events_q)
+    fin = [e for e in evs if isinstance(e, ev.FinalTurnComplete)]
+    assert fin and 0 < fin[0].completed_turns < 10**8
+    want = _oracle(fin[0].completed_turns)
+    assert set(fin[0].alive) == set(want.alive_cells())
+
+
+def test_sparse_detach_resume_in_process(tmp_path, out_dir, monkeypatch):
+    """'q' then CONT=yes on the module-held sparse engine: exact
+    continuation in torus coordinates."""
+    # Throttle: bounds t_detach so the SparseTorus oracle replay below
+    # stays cheap (an unthrottled warm engine reaches 10^4+ turns).
+    monkeypatch.setenv("GOL_MAX_CHUNK", "64")
+    images_dir = _seed_dir(tmp_path)
+    p1 = Params(threads=1, image_width=SIZE, image_height=SIZE,
+                turns=10**8)
+    q1, keys1 = queue.Queue(), queue.Queue()
+    t1 = run(p1, q1, keys1, images_dir=images_dir, out_dir=out_dir,
+             sparse=True)
+    time.sleep(2.0)
+    keys1.put("q")
+    t1.join(60)
+    assert not t1.is_alive()
+    evs1 = ev.drain(q1)
+    fin1 = [e for e in evs1 if isinstance(e, ev.FinalTurnComplete)][0]
+    t_detach = fin1.completed_turns
+    assert 0 < t_detach < 10**8
+
+    total = t_detach + 150
+    monkeypatch.setenv("CONT", "yes")
+    p2 = Params(threads=1, image_width=SIZE, image_height=SIZE,
+                turns=total)
+    q2 = queue.Queue()
+    run(p2, q2, None, images_dir=images_dir, out_dir=out_dir, sparse=True)
+    evs2 = ev.drain(q2)
+    fin2 = [e for e in evs2 if isinstance(e, ev.FinalTurnComplete)][0]
+    assert fin2.completed_turns == total
+    want = _oracle(total)
+    assert set(fin2.alive) == set(want.alive_cells())
+
+
+def test_sparse_checkpoint_round_trip(tmp_path):
+    eng = SparseEngine(SIZE)
+    seed = np.zeros((3, 3), dtype=np.uint8)
+    for x, y in R_PENTOMINO:
+        seed[y, x] = 255
+    p = Params(threads=1, image_width=SIZE, image_height=SIZE, turns=120)
+    eng.server_distributor(p, seed)
+    path = str(tmp_path / "sparse.npz")
+    eng.save_checkpoint(path)
+
+    eng2 = SparseEngine(SIZE)
+    assert eng2.load_checkpoint(path) == 120
+    # resumed evolution matches an uninterrupted replay
+    p2 = Params(threads=1, image_width=SIZE, image_height=SIZE, turns=80)
+    eng2.server_distributor(p2, None, start_turn=120)
+    want = _oracle(200)
+    assert eng2.alive_count() == (want.alive_count(), 200)
+
+    # guards: wrong torus size, wrong rule
+    with pytest.raises(ValueError):
+        SparseEngine(2**10).load_checkpoint(path)
+    from gol_tpu.models.lifelike import HIGHLIFE
+
+    with pytest.raises(ValueError):
+        SparseEngine(SIZE, rule=HIGHLIFE).load_checkpoint(path)
+
+
+def test_sparse_remote_server_e2e(tmp_path, out_dir, monkeypatch):
+    """A remote sparse engine (server --sparse equivalent) drives the
+    whole controller contract over TCP, including detach/reattach."""
+    from gol_tpu.server import EngineServer
+
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    monkeypatch.setenv("GOL_MAX_CHUNK", "64")  # bound the oracle replay
+    images_dir = _seed_dir(tmp_path)
+    srv = EngineServer(port=0, host="127.0.0.1",
+                       engine=SparseEngine(SIZE))
+    srv.start_background()
+    try:
+        monkeypatch.setenv("SER", f"127.0.0.1:{srv.port}")
+        # controller 1: detach mid-run
+        p1 = Params(threads=1, image_width=SIZE, image_height=SIZE,
+                    turns=10**8)
+        q1, keys1 = queue.Queue(), queue.Queue()
+        t1 = run(p1, q1, keys1, images_dir=images_dir, out_dir=out_dir,
+                 sparse=True)
+        time.sleep(2.5)
+        keys1.put("q")
+        t1.join(60)
+        assert not t1.is_alive()
+        fin1 = [e for e in ev.drain(q1)
+                if isinstance(e, ev.FinalTurnComplete)][0]
+        t_detach = fin1.completed_turns
+        assert 0 < t_detach < 10**8
+
+        # controller 2: reattach, finish exactly
+        total = t_detach + 100
+        monkeypatch.setenv("CONT", "yes")
+        p2 = Params(threads=1, image_width=SIZE, image_height=SIZE,
+                    turns=total)
+        q2 = queue.Queue()
+        run(p2, q2, None, images_dir=images_dir, out_dir=out_dir,
+            sparse=True)
+        monkeypatch.delenv("CONT")
+        fin2 = [e for e in ev.drain(q2)
+                if isinstance(e, ev.FinalTurnComplete)][0]
+        assert fin2.completed_turns == total
+        want = _oracle(total)
+        assert set(fin2.alive) == set(want.alive_cells())
+
+        # remote Stats reflects the sparse surface
+        from gol_tpu.client import RemoteEngine
+
+        st = RemoteEngine(f"127.0.0.1:{srv.port}").stats()
+        assert st["sparse"] and st["board"] == [SIZE, SIZE]
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_sparse_remote_size_mismatch_fails_fast(tmp_path, out_dir,
+                                                monkeypatch):
+    """A controller whose -w/-h disagree with the server's --sparse SIZE
+    must fail at attach (wrong modulus would silently corrupt final
+    torus coordinates), still delivering CLOSE."""
+    from gol_tpu.server import EngineServer
+
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    images_dir = _seed_dir(tmp_path)
+    srv = EngineServer(port=0, host="127.0.0.1",
+                       engine=SparseEngine(SIZE))
+    srv.start_background()
+    try:
+        monkeypatch.setenv("SER", f"127.0.0.1:{srv.port}")
+        p = Params(threads=1, image_width=2**15, image_height=2**15,
+                   turns=10)
+        q = queue.Queue()
+        t = run(p, q, None, images_dir=images_dir, out_dir=out_dir,
+                sparse=True)
+        evs = ev.drain(q)  # CLOSE must still arrive
+        t.join(30)
+        assert not t.is_alive()
+        assert isinstance(t.exception, ValueError)
+        assert not [e for e in evs if isinstance(e, ev.FinalTurnComplete)]
+    finally:
+        srv.shutdown()
+
+
+def test_sparse_flag_protocol_direct():
+    """Stranded-flag semantics match the dense engine: drain wipes a
+    parked engine's queue; pause_only keeps a quit; kill_prog kills."""
+    eng = SparseEngine(SIZE)
+    eng.cf_put(FLAG_PAUSE)
+    eng.cf_put(FLAG_QUIT)
+    eng.drain_flags(pause_only=True)
+    seed = np.zeros((3, 3), dtype=np.uint8)
+    for x, y in R_PENTOMINO:
+        seed[y, x] = 255
+    p = Params(threads=1, image_width=SIZE, image_height=SIZE,
+               turns=10**8)
+    t0 = time.monotonic()
+    _, turn = eng.server_distributor(p, seed)
+    assert time.monotonic() - t0 < 60
+    assert 0 <= turn < 10**8  # stranded quit honoured, pause wiped
+    eng.kill_prog()
+    with pytest.raises(EngineKilled):
+        eng.alive_count()
+
+
+def test_sparse_cli(tmp_path, monkeypatch):
+    """`gol-tpu --sparse --rle rpentomino` runs end to end headless."""
+    from gol_tpu.main import main as cli_main
+
+    out_dir = str(tmp_path / "out")
+    monkeypatch.setenv("GOL_OUT", out_dir)
+    rc = cli_main(["-w", str(SIZE), "-h", str(SIZE), "--turns", "150",
+                   "--rle", "rpentomino", "--sparse", "--headless"])
+    assert rc == 0
+    outs = os.listdir(out_dir)
+    assert any(f.endswith("x150.pgm") for f in outs)
