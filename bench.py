@@ -275,6 +275,55 @@ def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
     return 0 if parity is not False else 1
 
 
+def bench_generations(n: int, turns: int) -> int:
+    """Opt-in leg (`--gen`): Brian's Brain on the bit-plane packed
+    kernel — the Generations family's throughput number, gated on exact
+    board parity vs the independent uint8 LUT kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.models.generations import (
+        BRIANS_BRAIN,
+        packed_run_turns3,
+        run_turns,
+    )
+    from gol_tpu.ops.bitpack import pack, unpack
+    from gol_tpu.utils.sync import wait
+
+    rule = BRIANS_BRAIN
+    rng = np.random.default_rng(0)
+    board = rng.integers(0, 3, size=(n, n)).astype(np.uint8)
+    a = jnp.asarray(pack((board == 1).astype(np.uint8)))
+    d = jnp.asarray(pack((board == 2).astype(np.uint8)))
+
+    # parity gate: 64 turns, full board vs the uint8 LUT kernel
+    pa, pd = packed_run_turns3(a, d, 64, rule)
+    got = (np.asarray(unpack(pa)) + 2 * np.asarray(unpack(pd))
+           ).astype(np.uint8)
+    want = np.asarray(run_turns(jnp.asarray(board), 64, rule))
+    parity = bool(np.array_equal(got, want))
+    if not parity:
+        print(f"PARITY FAIL (generations {n}x{n})", file=sys.stderr)
+
+    wait(packed_run_turns3(a, d, turns, rule)[0])  # compile warmup
+    t0 = time.perf_counter()
+    oa, od = packed_run_turns3(a, d, turns, rule)
+    wait(oa)
+    wait(od)
+    elapsed = time.perf_counter() - t0
+    cups = turns * n * n / elapsed
+    _emit(
+        f"cell-updates/sec (Brian's Brain /2/3, {n}x{n} torus)",
+        round(cups, 1), "cell-updates/s", None,
+        {"size": n, "turns": turns, "elapsed_s": round(elapsed, 4),
+         "turns_per_s": round(turns / elapsed, 1),
+         "rule": rule.rulestring, "packed_planes": True,
+         "alive_parity": parity,
+         "parity_check": "full board vs uint8 LUT kernel, 64 turns"},
+    )
+    return 0 if parity else 1
+
+
 # Sized so the steady-state regime dominates the one-off chunk ramp
 # ~10x (the reference's default run is 10^10 turns, `Local/main.go:37` —
 # long runs are the honest interactive workload).
@@ -365,6 +414,9 @@ def main() -> int:
     ap.add_argument("--engine", action="store_true",
                     help="run the full-engine-stack 512² sustained leg "
                          "only (adaptive chunk pipeline + control plane)")
+    ap.add_argument("--gen", action="store_true",
+                    help="run the Generations-family leg (Brian's Brain "
+                         "bit-plane kernel; combine with --size/--turns)")
     args = ap.parse_args()
     # Same entry-point cache policy as the CLI/server: the bench compiles
     # ~a dozen distinct programs per matrix run (timed lengths, warmups,
@@ -375,11 +427,20 @@ def main() -> int:
     gol_tpu.maybe_enable_default_compile_cache()
 
     if args.engine:
-        if args.size is not None or args.pattern != "dense":
+        if args.size is not None or args.pattern != "dense" or args.gen:
             ap.error("--engine is its own config; combine only with "
                      "--turns")
         turns = args.turns if args.turns is not None else ENGINE_TURNS
         return bench_engine(turns)
+
+    if args.gen:
+        if args.pattern != "dense":
+            ap.error("--gen is a dense Generations config")
+        n = args.size if args.size is not None else 4096
+        # ~2 s of device compute at the measured ~4.8e11 gen-kernel cups
+        turns = (args.turns if args.turns is not None
+                 else max(256, int(1e12) // (n * n)))
+        return bench_generations(n, turns)
 
     if args.pattern != "dense":
         if args.size is not None:

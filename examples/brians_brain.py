@@ -1,5 +1,11 @@
 """Brian's Brain ('/2/3') — the Generations multi-state family on the
 bit-plane packed kernel. Run:  python examples/brians_brain.py [turns]
+
+This drives the kernel directly; since r4 the family also rides the
+FULL interactive stack (ticker, pause, snapshot, detach, checkpoints):
+
+    python -m gol_tpu -w 512 -h 512 --rule /2/3 --headless --turns 100
+    gol-tpu-server --rule /2/3     # remote engine, same contract
 """
 
 import sys

@@ -2,6 +2,13 @@
 materialised: only the live window is). Run:
 
     python examples/sparse_gun.py [turns]
+
+This drives the sparse kernel directly; since r4 sparse runs also ride
+the FULL control protocol (ticker, pause, windowed snapshots, detach,
+checkpoints):
+
+    python -m gol_tpu -w 1048576 -h 1048576 --sparse --rle gosper-gun --headless
+    gol-tpu-server --sparse 1048576   # remote sparse engine
 """
 
 import sys

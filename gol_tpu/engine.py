@@ -124,6 +124,25 @@ class EngineBusy(RuntimeError):
 
 
 @functools.lru_cache(maxsize=64)
+def _padded_row_counts(packed_repr: bool, pad: int):
+    """Cached jit fusing extension-crop + per-row count into ONE
+    dispatch — a separate eager slice would double the poll path's
+    round trips on the tunnel. Only life-like reprs can carry a pad."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    def rows(cells):
+        core = cells[: cells.shape[-2] - pad]
+        if packed_repr:
+            from gol_tpu.ops.bitpack import _row_popcounts
+
+            return _row_popcounts(core)
+        return jnp.sum(core, axis=-1, dtype=jnp.int32)
+
+    return rows
+
+
+@functools.lru_cache(maxsize=64)
 def _tokened_run(run_fn, mesh, rule):
     """Wrap a sharded run in one jitted program that ALSO returns a tiny
     completion token (a full-board reduction — it reads every shard on
@@ -213,6 +232,10 @@ class Engine:
         self._cells: Optional[jax.Array] = None
         self._repr = "u8"
         self._packed = False
+        # Wrap-extension rows appended for the exact-shard-count path
+        # (`parallel/halo.exact_shard_ext`): every query/serialization
+        # path crops them — they are representation, not board.
+        self._pad_rows = 0
         self._turn = 0
         self._flags: "queue.Queue[int]" = queue.Queue()
         self._killed = False
@@ -260,6 +283,7 @@ class Engine:
             raise EngineBusy("engine already running a board")
 
         height, width = world.shape
+        pad_rows = 0  # wrap-extension rows (exact-shard-count path)
         if isinstance(self._rule, GenerationsRule):
             # Multi-state family on the SAME control stack (r4 — VERDICT
             # r3 weak #5): uint8 states row-sharded through the generic
@@ -313,16 +337,38 @@ class Engine:
                 requested = (len(sub_workers) if sub_workers
                              else params.threads)
                 requested = max(1, min(requested, len(self._devices)))
-                n_shards = resolve_shard_count(height, requested)
-                mesh = make_mesh(n_shards, self._devices)
-                cells = shard_board(
-                    pack(cells01) if packed else cells01, mesh)
+                from gol_tpu.parallel.halo import (
+                    exact_shard_ext,
+                    extend_rows,
+                    extended_run_fn,
+                )
+
+                pad_rows = exact_shard_ext(height, requested)
+                if pad_rows:
+                    # Exact requested shard count on a non-divisible
+                    # height (reference remainder-spread parity,
+                    # `Server/gol/distributor.go:106-116`): wrap-extend
+                    # the board so it splits evenly and let GSPMD place
+                    # the cross-shard seam traffic.
+                    mesh = make_mesh(requested, self._devices)
+                    base = np.asarray(
+                        pack(cells01) if packed else cells01)
+                    cells = shard_board(
+                        extend_rows(base, pad_rows), mesh)
+                    run = extended_run_fn(height, pad_rows, packed)
+                else:
+                    # pad_rows == 0 means requested == 1 or it divides
+                    # the height — equal shards, no downgrade possible.
+                    mesh = make_mesh(requested, self._devices)
+                    cells = shard_board(
+                        pack(cells01) if packed else cells01, mesh)
         with self._state_lock:
             if self._running:  # re-check under the lock (TOCTOU)
                 raise EngineBusy("engine already running a board")
             self._cells = cells
             self._repr = repr_
             self._packed = repr_ == "packed"
+            self._pad_rows = pad_rows
             self._turn = start_turn
             self._running = True
             self._run_token = token
@@ -497,6 +543,7 @@ class Engine:
                 # can install a new board, and a later _snapshot() would
                 # hand the first caller the second run's state.
                 final_cells, final_repr = self._cells, self._repr
+                final_pad = self._pad_rows
                 final_turn = self._turn
                 self._running = False
                 self._run_token = None
@@ -504,7 +551,8 @@ class Engine:
         # On kill_prog mid-run, still hand back the partial board — the
         # state exists and discarding completed turns helps nobody; further
         # RPCs on this engine raise EngineKilled.
-        return self._materialize(final_cells, final_repr), final_turn
+        return (self._materialize(final_cells, final_repr, final_pad),
+                final_turn)
 
     def alive_count(self) -> Tuple[int, int]:
         """(alive, completed turn), coherent pair (ref `Server:69-75`).
@@ -513,8 +561,13 @@ class Engine:
         self._check_alive()
         with self._state_lock:
             cells, turn, repr_ = self._cells, self._turn, self._repr
+            pad = self._pad_rows
         if cells is None:
             return 0, turn
+        if pad:
+            rows = _padded_row_counts(repr_ == "packed", pad)(cells)
+            return (int(np.asarray(jax.device_get(rows),
+                                   dtype=np.int64).sum()), turn)
         if repr_ == "packed":
             count = packed_alive_count(cells)
         elif repr_ == "u8":
@@ -620,7 +673,7 @@ class Engine:
             cells = self._cells
             shape = None
             if cells is not None:
-                h, w = cells.shape[-2], cells.shape[-1]
+                h, w = cells.shape[-2] - self._pad_rows, cells.shape[-1]
                 if self._repr in ("packed", "gen3"):
                     w *= WORD_BITS  # last axis is 32-cell words
                 shape = [h, w]
@@ -659,8 +712,11 @@ class Engine:
         complete checkpoint (last one wins)."""
         with self._state_lock:
             cells, turn, repr_ = self._cells, self._turn, self._repr
+            pad = self._pad_rows
         if cells is None:
             raise RuntimeError("no board loaded")
+        if pad:
+            cells = cells[: cells.shape[-2] - pad]
         if repr_ == "packed":
             from gol_tpu.ops.bitpack import WORD_BITS
 
@@ -780,6 +836,7 @@ class Engine:
             self._cells = cells
             self._repr = repr_
             self._packed = repr_ == "packed"
+            self._pad_rows = 0  # checkpoints store cropped boards
             self._turn = turn
         return turn
 
@@ -809,15 +866,18 @@ class Engine:
     def _snapshot(self) -> Tuple[np.ndarray, int]:
         with self._state_lock:
             cells, turn, repr_ = self._cells, self._turn, self._repr
-        return self._materialize(cells, repr_), turn
+            pad = self._pad_rows
+        return self._materialize(cells, repr_, pad), turn
 
-    def _materialize(self, cells, repr_: str) -> np.ndarray:
+    def _materialize(self, cells, repr_: str, pad: int = 0) -> np.ndarray:
         """Device state handle -> host pixel array (blocks until the
         handle is real). Life-like boards materialize as {0,255};
         Generations boards as the documented state-scaled gray encoding
         (`models/generations.gray_levels`)."""
         if cells is None:
             raise RuntimeError("no board loaded")
+        if pad:
+            cells = cells[: cells.shape[-2] - pad]
         if repr_ == "packed":
             return np.asarray(jax.device_get(to_pixels(unpack(cells))))
         if repr_ == "u8":

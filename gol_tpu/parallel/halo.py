@@ -336,6 +336,116 @@ def sharded_packed_run_turns(
         packed, num_turns)
 
 
+# ----------------------------------------------- exact-N odd heights
+#
+# The reference serves EXACTLY the requested worker count on any board
+# height by spreading the H mod N remainder rows over the first strips
+# (`Server/gol/distributor.go:106-116`). shard_map needs equal shards,
+# so the TPU-native equivalent is wrap-extension (the SURVEY §2c plan:
+# "padding instead of remainder-spread"): extend the board with a copy
+# of its first `ext` rows so H + ext divides N, step the extension as an
+# ordinary (H+ext)-torus, and re-establish the invariant each turn.
+#
+# Correctness: with P = [B[0..H-1], B[0..ext-1]] and P2 = torus-step(P),
+# row j in 1..H-1 sees true neighbours (P[H] = B[0] gives row H-1 its
+# wrap), and P2[H] = f(B[H-1], B[0], B[1]) is the true new row 0 (needs
+# ext >= 2 so P[H+1] = B[1] exists). So B' = [P2[H], P2[1:H]] exactly,
+# and the extension is rebuilt from B' by construction. The per-turn
+# slice/concat crosses shard boundaries — XLA/GSPMD inserts the
+# collectives — making this the *correct-by-construction fallback* for
+# heights the ppermute ring can't split evenly; even heights keep the
+# faster equal-shard path.
+
+
+def exact_shard_ext(height: int, n_shards: int) -> int:
+    """Smallest extension >= 2 making height + ext divisible; 0 when the
+    height already divides (no extension needed)."""
+    if n_shards <= 1 or height % n_shards == 0:
+        return 0
+    ext = 2
+    while (height + ext) % n_shards:
+        ext += 1
+    return ext
+
+
+def extend_rows(board: np.ndarray, ext: int) -> np.ndarray:
+    """[B; B[(0:ext) mod H]] — the wrap-extended board (host-side, at
+    submit). Cyclic indexing: when ext > H (tiny boards on wide meshes,
+    e.g. H=2 over 8 shards needs ext=6) the extension is the torus
+    unrolled, not a short slice."""
+    import numpy as _np
+
+    idx = _np.arange(ext) % board.shape[0]
+    return _np.concatenate([board, board[idx]], axis=0)
+
+
+@functools.lru_cache(maxsize=128)
+def _make_extended_run(height: int, ext: int, packed: bool, mesh: Mesh,
+                       rule: LifeLikeRule):
+    """jitted (extended board, num_turns-static) -> extended board:
+    torus-step + invariant rebuild per turn, sharded exactly N ways over
+    `mesh` rows via GSPMD (sharding constraint on the scan carry)."""
+    from jax.sharding import NamedSharding
+
+    from gol_tpu.ops.bitpack import packed_step
+    from gol_tpu.ops.stencil import step as u8_step
+
+    if ext < 2:
+        # The rebuild reads P2[H], whose below-neighbour P[H+1] must
+        # exist — a smaller extension silently computes garbage.
+        raise ValueError(f"wrap extension needs ext >= 2, got {ext}")
+    sh = NamedSharding(mesh, P(ROWS_AXIS, None))
+    inner = packed_step if packed else u8_step
+
+    ext_idx = tuple(range(ext))  # static; cyclic when ext > height
+
+    @functools.partial(jax.jit, static_argnames=("num_turns",))
+    def run(board: jax.Array, num_turns: int) -> jax.Array:
+        idx = jnp.array([i % height for i in ext_idx], dtype=jnp.int32)
+
+        def body(prev, _):
+            stepped = inner(prev, rule)
+            core = jnp.concatenate(
+                [stepped[height:height + 1], stepped[1:height]], axis=0)
+            nxt = jnp.concatenate(
+                [core, jnp.take(core, idx, axis=0)], axis=0)
+            return lax.with_sharding_constraint(nxt, sh), None
+
+        out, _ = lax.scan(body, board, None, length=num_turns)
+        return out
+
+    return run
+
+
+def extended_run_turns(
+    board: jax.Array,
+    num_turns: int,
+    mesh: Mesh,
+    rule: LifeLikeRule = CONWAY,
+    *,
+    height: int,
+    ext: int,
+    packed: bool,
+) -> jax.Array:
+    """Advance a wrap-extended board (see module note above) — the
+    exact-shard-count path for heights not divisible by the mesh."""
+    return _make_extended_run(height, ext, packed, mesh, rule)(
+        board, num_turns)
+
+
+@functools.lru_cache(maxsize=128)
+def extended_run_fn(height: int, ext: int, packed: bool):
+    """A stable-identity (cells, k, mesh, rule) run callable for the
+    wrap-extension path — cached so the engine's `_tokened_run` lru
+    cache keys on one object per (height, ext, tier)."""
+    def run(cells, num_turns, mesh, rule=CONWAY):
+        return extended_run_turns(
+            cells, num_turns, mesh, rule,
+            height=height, ext=ext, packed=packed)
+
+    return run
+
+
 # ------------------------------------------------------- Generations
 #
 # The multi-state family rides the SAME shard_map + ppermute machinery:

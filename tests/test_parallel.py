@@ -187,3 +187,82 @@ def test_inner_kind_prefers_banded_for_aligned_windows():
     assert inner_kind(FakeMesh, (70000, 2048), 4) == "jnp"
     assert inner_kind(FakeMesh, (70000, 2048), 16) == "banded"
     assert inner_kind(FakeMesh, (160, 128), 4) == "banded"  # fits VMEM
+
+
+# --------------------------------------------- exact-N on odd heights
+
+@pytest.mark.parametrize("h,w,n", [(17, 64, 8), (23, 96, 5), (9, 32, 4),
+                                   (100, 33, 7), (2, 64, 8)])
+def test_wrap_extension_exact_shards(h, w, n):
+    """Exact requested shard count on ANY height (reference remainder-
+    spread parity, `Server/gol/distributor.go:106-116`): the wrap-
+    extension path is bitwise identical to the single-device kernel,
+    both tiers, including ext > H (tiny board, wide mesh)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gol_tpu.ops.bitpack import pack, unpack
+    from gol_tpu.ops.stencil import from_pixels
+    from gol_tpu.parallel.halo import (
+        exact_shard_ext,
+        extend_rows,
+        extended_run_turns,
+    )
+    from gol_tpu.parallel.mesh import ROWS_AXIS
+
+    cells = random_board(h, w, seed=h * n)
+    turns = 15
+    want = np.asarray(run_turns(cells, turns))
+    ext = exact_shard_ext(h, n)
+    assert ext >= 2 and (h + ext) % n == 0
+    mesh = make_mesh(n)
+    sh = NamedSharding(mesh, P(ROWS_AXIS, None))
+    dev = jax.device_put(
+        extend_rows(np.asarray(from_pixels(cells)), ext), sh)
+    got = np.asarray(extended_run_turns(
+        dev, turns, mesh, height=h, ext=ext, packed=False))[:h]
+    np.testing.assert_array_equal(got, want)
+    if w % 32 == 0:
+        devp = jax.device_put(
+            extend_rows(np.asarray(pack(cells)), ext), sh)
+        gotp = np.asarray(unpack(extended_run_turns(
+            devp, turns, mesh, height=h, ext=ext, packed=True)))[:h]
+        np.testing.assert_array_equal(gotp, want)
+
+
+def test_engine_serves_exact_worker_count_on_odd_height(recwarn):
+    """The ENGINE serves a non-divisor worker request exactly — no
+    downgrade warning — and every query path (run result, alive_count,
+    get_world, stats, checkpoint) crops the extension rows."""
+    import tempfile
+
+    from gol_tpu.engine import Engine
+    from gol_tpu.ops.reference import run_turns_np
+    from gol_tpu.params import Params
+
+    h, w, turns = 17, 64, 20
+    world = random_board(h, w, seed=3) * 255
+    eng = Engine()
+    p = Params(threads=5, image_width=w, image_height=h, turns=turns)
+    out, turn = eng.server_distributor(p, world)
+    assert turn == turns
+    assert out.shape == (h, w)
+    want = run_turns_np((world != 0).astype(np.uint8), turns)
+    np.testing.assert_array_equal((out != 0).astype(np.uint8), want)
+    assert not [wn for wn in recwarn.list
+                if "downgraded" in str(wn.message)]
+
+    alive, t = eng.alive_count()
+    assert (alive, t) == (int(want.sum()), turns)
+    snap, _ = eng.get_world()
+    assert snap.shape == (h, w)
+    assert eng.stats()["board"] == [h, w]
+
+    with tempfile.TemporaryDirectory() as d:
+        import os as _os
+
+        path = _os.path.join(d, "ck.npz")
+        eng.save_checkpoint(path)
+        eng2 = Engine()
+        assert eng2.load_checkpoint(path) == turns
+        snap2, _ = eng2.get_world()
+        np.testing.assert_array_equal(snap2, snap)
