@@ -364,13 +364,15 @@ def bench_engine(turns: int = ENGINE_TURNS) -> int:
         return 0
     # Warmup: a shorter run compiles the chunk-ramp program ladder (same
     # jit cache) so the timed run measures the engine, not one-off XLA
-    # compiles — the same methodology as the dense legs' warmup. Capped
-    # at the timed length: a small --turns run ramps through the same
-    # (or a shorter) ladder.
+    # compiles — the same methodology as the dense legs' warmup. Sized to
+    # get PAST the ramp and execute the steady 2^21 chunk at least once
+    # (ramp ~1.1M turns + two steady chunks + tails): a 2M warmup used to
+    # leave the steady chunk's ~1 s first-dispatch stall inside the timed
+    # run (r4: measured 4.2 vs 5.2M turns/s). Capped at the timed length.
     if turns > 0:
         Engine().server_distributor(
             Params(threads=8, image_width=512, image_height=512,
-                   turns=min(2_000_000, turns)), world)
+                   turns=min(6_000_000, turns)), world)
     p = Params(threads=8, image_width=512, image_height=512, turns=turns)
     eng = Engine()
     t0 = time.perf_counter()
