@@ -256,6 +256,10 @@ class Engine:
         # Rolling throughput telemetry for the Stats RPC.
         self._last_chunk = 0
         self._turns_per_s = 0.0
+        # Converged chunk size per (board shape, repr, mesh, target):
+        # later runs of the same configuration start there, skipping the
+        # synchronous ramp's round trips.
+        self._chunk_hints: dict = {}
 
     # ------------------------------------------------------------------ RPC
 
@@ -375,7 +379,6 @@ class Engine:
             self._abort.clear()
 
         target = start_turn + params.turns
-        chunk = 1
         self._max_chunk = env_int(MAX_CHUNK_ENV, MAX_CHUNK)
         # `or`: a zero/unset target would make both adapters halve
         # forever (chunk pinned at 1 ≈ one round trip per turn) — 0 is
@@ -383,6 +386,20 @@ class Engine:
         self._chunk_target = (
             env_float(CHUNK_TARGET_ENV, CHUNK_TARGET_SECONDS)
             or CHUNK_TARGET_SECONDS)
+        # Start where the last run of this same (board geometry, repr,
+        # shard count) converged instead of re-ramping from 1: resubmits
+        # and reattaches skip ~7 synchronous round trips. The hint is
+        # only a starting point — if the regime changed (env caps, a
+        # slower link) the adapters re-correct within a few chunks.
+        hint_key = (cells.shape, repr_, tuple(mesh.devices.shape),
+                    self._chunk_target)
+        # Floor to a power of two <= the cap: min() alone would hand a
+        # non-power-of-two GOL_MAX_CHUNK straight to the dispatch loop,
+        # breaking the bounded-compiled-program invariant (_next_chunk).
+        chunk = 1
+        hinted = min(self._chunk_hints.get(hint_key, 1), self._max_chunk)
+        while chunk * 2 <= hinted:
+            chunk *= 2
         quit_run = False
         trace_dir = os.environ.get(TRACE_ENV, "")
         ckpt_dir = os.environ.get(CKPT_ENV, "")
@@ -545,6 +562,7 @@ class Engine:
                 final_cells, final_repr = self._cells, self._repr
                 final_pad = self._pad_rows
                 final_turn = self._turn
+                self._chunk_hints[hint_key] = chunk
                 self._running = False
                 self._run_token = None
                 self._abort.clear()
