@@ -71,6 +71,10 @@ class SparseEngine:
             raise ValueError(f"torus size {size} not a multiple of 32")
         self.size = size
         self._rule = rule
+        # Single-device by design (the live window is one shard); the
+        # attribute exists for surfaces that introspect any engine's
+        # devices (server main's banner).
+        self._devices = [jax.devices()[0]]
         self._state_lock = threading.Lock()
         self._torus: Optional[SparseTorus] = None
         self._turn = 0

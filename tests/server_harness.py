@@ -1,0 +1,65 @@
+"""Shared subprocess harness for engine-server e2e tests (dense and
+sparse failure-recovery suites): spawn a real `gol_tpu.server` process on
+the virtual CPU mesh and read its port announcement. A non-test module so
+both suites import ONE module identity (importing helpers from another
+test file would re-execute that file's body under a second name)."""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import threading
+
+
+def spawn_server(port: int, tmp_path, extra_env=None, resume="",
+                 extra_args=()):
+    """EngineServer subprocess on the virtual CPU mesh (site hook beats
+    env vars, so the platform is forced via jax.config — same bootstrap
+    as tests/conftest.py)."""
+    argv = ["server", "--port", str(port), *extra_args]
+    if resume:
+        argv += ["--resume", resume]
+    launcher = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
+        "' --xla_force_host_platform_device_count=8'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import sys\n"
+        f"sys.argv = {argv!r}\n"
+        "from gol_tpu.server import main\n"
+        "main()\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("SER", None)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-u", "-c", launcher],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=str(tmp_path),
+    )
+
+
+def wait_port(proc, timeout=120):
+    """The port from the server's 'serving on :N' banner, or None."""
+    found = {}
+
+    def scan():
+        for line in proc.stdout:
+            m = re.search(r"serving on :(\d+)", line)
+            if m:
+                found["port"] = int(m.group(1))
+                return
+
+    t = threading.Thread(target=scan, daemon=True)
+    t.start()
+    t.join(timeout)
+    return found.get("port")

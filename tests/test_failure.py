@@ -558,54 +558,10 @@ def test_reconnect_disabled_propagates(images_dir, out_dir, monkeypatch):
     assert not [e for e in evs if isinstance(e, ev.EngineLost)]
 
 
-def _spawn_server(port: int, tmp_path, extra_env=None, resume=""):
-    """EngineServer subprocess on the virtual CPU mesh (site hook beats
-    env vars, so the platform is forced via jax.config — same bootstrap as
-    tests/conftest.py)."""
-    argv = ["server", "--port", str(port)]
-    if resume:
-        argv += ["--resume", resume]
-    launcher = (
-        "import os\n"
-        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
-        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
-        "' --xla_force_host_platform_device_count=8'\n"
-        "import jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n"
-        "import sys\n"
-        f"sys.argv = {argv!r}\n"
-        "from gol_tpu.server import main\n"
-        "main()\n"
-    )
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env.pop("SER", None)
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    env.update(extra_env or {})
-    return subprocess.Popen(
-        [sys.executable, "-u", "-c", launcher],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        env=env,
-        cwd=str(tmp_path),
-    )
-
-
-def _wait_port(proc, timeout=120):
-    found = {}
-
-    def scan():
-        for line in proc.stdout:
-            m = re.search(r"serving on :(\d+)", line)
-            if m:
-                found["port"] = int(m.group(1))
-                return
-
-    t = threading.Thread(target=scan, daemon=True)
-    t.start()
-    t.join(timeout)
-    return found.get("port")
+from tests.server_harness import (  # noqa: E402 — shared e2e harness
+    spawn_server as _spawn_server,
+    wait_port as _wait_port,
+)
 
 
 def test_sigkill_restart_resume_e2e(images_dir, out_dir, tmp_path,

@@ -286,6 +286,103 @@ def test_sparse_remote_size_mismatch_fails_fast(tmp_path, out_dir,
         srv.shutdown()
 
 
+@pytest.mark.timeout(420)
+def test_sparse_sigkill_restart_resume_e2e(tmp_path, out_dir, monkeypatch):
+    """The full sparse failure-recovery story across real process
+    boundaries: `gol-tpu-server --sparse` SIGKILLed mid-run; a
+    replacement server restores the periodic sparse checkpoint
+    (--resume); the controller reattaches (engine-held window, world
+    stays None) and finishes; the final cells are an exact replay."""
+    import signal
+    import threading
+
+    from gol_tpu.distributor import distributor
+    from tests.server_harness import spawn_server, wait_port
+
+    size = SIZE
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt_path = os.path.join(ckpt_dir, f"sparse{size}x{size}.npz")
+    server_env = {
+        "GOL_CKPT": ckpt_dir,
+        "GOL_CKPT_EVERY": "0.3",
+        "GOL_MAX_CHUNK": "64",  # slow engine, fresh checkpoints
+    }
+    sparse_args = ("--sparse", str(size))
+    images_dir = _seed_dir(tmp_path)
+    proc1 = spawn_server(0, tmp_path, extra_env=server_env,
+                         extra_args=sparse_args)
+    proc2 = None
+    collected = []
+    closed = threading.Event()
+    try:
+        port = wait_port(proc1)
+        assert port, "sparse server never announced its port"
+        monkeypatch.setenv("SER", f"127.0.0.1:{port}")
+        monkeypatch.setenv("GOL_RECONNECT", "180")
+        monkeypatch.setenv("GOL_HB_INTERVAL", "0.3")
+        monkeypatch.setenv("GOL_HB_MISSES", "2")
+
+        p = Params(threads=1, image_width=size, image_height=size,
+                   turns=10**8)
+        q, keys = queue.Queue(), queue.Queue()
+
+        def collect():
+            while True:
+                e = q.get()
+                if e is ev.CLOSE:
+                    closed.set()
+                    return
+                collected.append(e)
+
+        threading.Thread(target=collect, daemon=True).start()
+        ctrl = threading.Thread(
+            target=distributor, args=(p, q, keys),
+            kwargs=dict(images_dir=images_dir, out_dir=out_dir,
+                        sparse=True),
+            daemon=True)
+        ctrl.start()
+
+        deadline = time.monotonic() + 90
+        while not os.path.exists(ckpt_path):
+            assert time.monotonic() < deadline, "no sparse checkpoint"
+            time.sleep(0.2)
+        time.sleep(1.0)
+
+        os.kill(proc1.pid, signal.SIGKILL)
+        proc1.wait(10)
+
+        deadline = time.monotonic() + 60
+        while not any(isinstance(e, ev.EngineLost) for e in collected):
+            assert time.monotonic() < deadline, "EngineLost never emitted"
+            assert ctrl.is_alive()
+            time.sleep(0.1)
+
+        proc2 = spawn_server(port, tmp_path, extra_env=server_env,
+                             resume=ckpt_path, extra_args=sparse_args)
+        deadline = time.monotonic() + 150
+        while not any(isinstance(e, ev.EngineReattached)
+                      for e in collected):
+            assert time.monotonic() < deadline, "never reattached"
+            assert ctrl.is_alive()
+            time.sleep(0.2)
+
+        keys.put("q")
+        ctrl.join(60)
+        assert not ctrl.is_alive()
+        assert closed.wait(10)
+
+        final = [e for e in collected
+                 if isinstance(e, ev.FinalTurnComplete)][0]
+        assert final.completed_turns > 0
+        want = _oracle(final.completed_turns)
+        assert set(final.alive) == set(want.alive_cells())
+    finally:
+        for proc in (proc1, proc2):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                proc.wait(10)
+
+
 def test_sparse_flag_protocol_direct():
     """Stranded-flag semantics match the dense engine: drain wipes a
     parked engine's queue; pause_only keeps a quit; kill_prog kills."""
