@@ -68,6 +68,23 @@ def main() -> None:
         got = np.asarray(unpack(np.asarray(s.data)))
         np.testing.assert_array_equal(got, want[s.index])
 
+    # Generations family (r4): the multi-state LUT kernel's halo ring
+    # must also span the process boundary.
+    import jax.numpy as jnp
+
+    from gol_tpu.models.generations import BRIANS_BRAIN
+    from gol_tpu.models.generations import run_turns as gen_run_turns
+    from gol_tpu.parallel.halo import sharded_generations_run_turns
+
+    state = rng.integers(0, 3, size=(n, n)).astype(np.uint8)
+    gwant = np.asarray(gen_run_turns(
+        jnp.asarray(state), turns, BRIANS_BRAIN))
+    garr = jax.make_array_from_callback(
+        (n, n), board_sharding(mesh), lambda idx: state[idx])
+    gout = sharded_generations_run_turns(garr, turns, mesh, BRIANS_BRAIN)
+    for s in gout.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(s.data), gwant[s.index])
+
     print(f"MULTIHOST_OK proc {pid} ({len(shards)} local shards)",
           flush=True)
 
