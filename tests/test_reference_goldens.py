@@ -136,6 +136,39 @@ def test_alive_counts_10000_turns_packed(size):
         assert (want[-2], want[-1]) in ((5565, 5567), (5567, 5565))
 
 
+@pytest.mark.parametrize("size", SIZES)
+def test_full_stack_run_against_reference_goldens(size, out_dir):
+    """The WHOLE framework stack — gol.run, distributor, engine, PGM io —
+    driven from the reference's own input images to its own golden
+    outputs (`Local/gol_test.go:11-43` is exactly this contract): the
+    final event's cell set and the written PGM both match
+    `check/images/{size}x{size}x100.pgm`."""
+    import queue
+
+    import jax  # noqa: F401 — backend from conftest
+
+    from gol_tpu import Params, events as ev, run
+    from gol_tpu.engine import Engine
+    from gol_tpu.io.pgm import output_path, read_pgm
+    from gol_tpu.utils.cell import read_alive_cells
+
+    p = Params(threads=4, image_width=size, image_height=size, turns=100)
+    q = queue.Queue()
+    run(p, q, None, engine=Engine(),
+        images_dir=str(REF / "images"), out_dir=out_dir)
+    evs = ev.drain(q)
+    finals = [e for e in evs if isinstance(e, ev.FinalTurnComplete)]
+    assert len(finals) == 1, f"expected one final event, got {finals}"
+    fin = finals[0]
+    assert fin.completed_turns == 100
+    want = {(c.x, c.y) for c in read_alive_cells(
+        str(REF / "check" / "images" / f"{size}x{size}x100.pgm"),
+        size, size)}
+    assert set(fin.alive) == want
+    out_board = read_pgm(output_path(size, size, 100, out_dir))
+    np.testing.assert_array_equal(out_board, _ref_golden(size, 100))
+
+
 @pytest.mark.timeout(600)
 @pytest.mark.parametrize("size", (16, 64))
 def test_alive_counts_10000_turns_uint8(size):
