@@ -182,6 +182,7 @@ def distributor(
 
     width, height = p.image_width, p.image_height
     done = threading.Event()
+    helper_threads: list = []
     kp_state = {"k": False}
     # Shared pause state (keypress thread toggles, recovery loop reads
     # and resets): a controller-local bool could silently invert against
@@ -278,6 +279,14 @@ def distributor(
                 # Transient engine state (e.g. snapshot requested before the
                 # board is loaded) — drop this keypress, keep serving.
                 continue
+            except ValueError:
+                # A snapshot write can reject the payload (e.g. a remote
+                # multi-state engine's gray pixels against this
+                # controller's {0,255}/levels expectation when GOL_RULE
+                # doesn't mirror the server's --rule). Losing one 's' is
+                # recoverable; losing the keypress THREAD would strand
+                # q/k/p for the rest of the run.
+                continue
 
     # -- 2 s alive ticker (`Local/gol/distributor.go:154-167`) ------------
     def ticker_loop() -> None:
@@ -352,10 +361,15 @@ def distributor(
                     f"--sparse SIZE")
 
         if key_presses is not None:
-            threading.Thread(target=keypress_loop, daemon=True).start()
-        threading.Thread(target=ticker_loop, daemon=True).start()
+            helper_threads.append(threading.Thread(
+                target=keypress_loop, daemon=True))
+        helper_threads.append(threading.Thread(
+            target=ticker_loop, daemon=True))
         if live_view:
-            threading.Thread(target=live_loop, daemon=True).start()
+            helper_threads.append(threading.Thread(
+                target=live_loop, daemon=True))
+        for t in helper_threads:
+            t.start()
 
         # -- board source: fresh from PGM, or reattach (`:171-178`) -------
         start_turn = 0
@@ -622,3 +636,11 @@ def distributor(
     finally:
         done.set()
         events_q.put(ev.CLOSE)
+        # Bounded join of the helper threads AFTER CLOSE is delivered:
+        # a daemon ticker still inside a device fetch when the process
+        # begins interpreter finalization aborts the native runtime
+        # ("terminate called ..." at exit). done is set, so each loop
+        # exits as soon as its in-flight poll completes; the timeout
+        # keeps a wedged engine from blocking the caller forever.
+        for t in helper_threads:
+            t.join(timeout=5.0)

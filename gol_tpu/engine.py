@@ -142,6 +142,15 @@ def _padded_row_counts(packed_repr: bool, pad: int):
     return rows
 
 
+@jax.jit
+def _gen3_state(cells):
+    """Stacked packed (2, H, Wp) planes -> uint8 state board (H, W),
+    one program, one transfer."""
+    import jax.numpy as jnp
+
+    return (unpack(cells[0]) + 2 * unpack(cells[1])).astype(jnp.uint8)
+
+
 @functools.lru_cache(maxsize=64)
 def _tokened_run(run_fn, mesh, rule):
     """Wrap a sharded run in one jitted program that ALSO returns a tiny
@@ -184,7 +193,123 @@ def _next_chunk(chunk: int, remaining: int) -> int:
     return max(k, 1)
 
 
-class Engine:
+class ControlFlagProtocol:
+    """The reference control-flag protocol + liveness surface, shared
+    by every engine flavour (dense `Engine`,
+    `sparse_engine.SparseEngine`). Subclasses provide: `_flags`
+    (queue.Queue), `_killed` (bool), `_abort` (threading.Event),
+    `_state_lock`, `_running`, `_run_token`, `_turn`. One
+    implementation so the subtle semantics (drain inside the lock,
+    pause_only, token-scoped abort) cannot drift between engines."""
+
+    def cf_put(self, flag: int) -> None:
+        """Post a control flag (ref `Server:54-60`)."""
+        self._check_alive()
+        if flag not in (FLAG_PAUSE, FLAG_QUIT, FLAG_KILL):
+            raise ValueError(f"unknown control flag {flag}")
+        self._flags.put(flag)
+
+    def drain_flags(self, pause_only: bool = False) -> None:
+        """Discard STALE control flags — those left by a previous
+        (detached/dead) controller session on a PARKED engine. A no-op
+        while a run is in flight: an attaching observer must not be able
+        to wipe the running controller's pause/quit flags out of the
+        queue (flags are not token-scoped the way abort_run is).
+        Reference analog: the broker's flag channel is emptied by its
+        per-turn sentinel cycle, `Server:136-150`.
+
+        `pause_only` drops only FLAG_PAUSE entries (re-queuing the rest
+        in order): the loss-recovery path uses it because a stranded
+        pause toggle would invert controller-vs-engine pause state on
+        the resubmitted run, while a stranded quit/kill is an idempotent
+        order the resubmitted run SHOULD honour."""
+        self._check_alive()
+        with self._state_lock:
+            if self._running:
+                return
+            # Drain INSIDE the lock: a run starting in the gap between
+            # the check and the drain could have its controller's early
+            # pause/quit flags wiped by this observer (server_distributor
+            # flips _running under the same lock, so holding it here
+            # excludes that window; cf_put itself is queue-safe and
+            # lock-free).
+            kept = []
+            try:
+                while True:
+                    flag = self._flags.get_nowait()
+                    if pause_only and flag != FLAG_PAUSE:
+                        kept.append(flag)
+            except queue.Empty:
+                pass
+            for flag in kept:
+                self._flags.put(flag)
+
+    def kill_prog(self) -> None:
+        """Mark the engine dead (ref `Server:77-80`, worker os.Exit)."""
+        self._killed = True
+
+    def abort_run(self, token: Optional[str] = None) -> bool:
+        """Stop the current run iff `token` matches the run owner's —
+        the recovery takeover after a transient partition (the controller
+        resubmits, finds its pre-partition orphan still computing, and
+        reclaims the engine). No reference counterpart: the Go broker has
+        no way to be reclaimed by a controller that lost it. No-op (False)
+        when idle or when the run belongs to another controller; on abort
+        the state is preserved at the stop point exactly like FLAG_QUIT.
+        A tokenless run cannot be aborted at all (None never matches) —
+        otherwise any peer sending AbortRun with no token could stop a
+        legacy client's run."""
+        self._check_alive()
+        with self._state_lock:
+            if (token is not None and self._running
+                    and self._run_token == token):
+                self._abort.set()
+                return True
+            return False
+
+    def ping(self) -> int:
+        """Liveness probe: the completed turn, with no device work — cheap
+        enough for a sub-second heartbeat. Beyond-reference addition (the
+        reference has no failure detection, SURVEY §5); a killed engine
+        still answers (with EngineKilled), distinguishing 'deliberately
+        down' from 'lost'."""
+        self._check_alive()
+        with self._state_lock:
+            return self._turn
+
+    def _check_alive(self) -> None:
+        if self._killed:
+            raise EngineKilled("engine has been killed")
+
+    def _handle_flags(self) -> bool:
+        """Drain flags; block while paused. Returns True to quit the run
+        (reference handshake `Server/gol/distributor.go:136-164`)."""
+        paused = False
+        while True:
+            if self._killed or self._abort.is_set():
+                return True
+            try:
+                flag = self._flags.get_nowait() if not paused \
+                    else self._flags.get(timeout=0.05)
+            except queue.Empty:
+                if not paused:
+                    return False
+                continue
+            if flag == FLAG_PAUSE:
+                paused = not paused
+                if not paused:
+                    return False
+            elif flag in (FLAG_QUIT, FLAG_KILL):
+                # Both break the run loop and still return the board to the
+                # controller; on kill the reference broker first downs its
+                # workers then returns, and only dies when the controller
+                # calls KillProg afterwards (`Server:157-164`,
+                # `Local/gol/distributor.go:213-216`). Our "workers" are the
+                # compiled program — nothing to down until kill_prog().
+                return True
+
+
+class Engine(ControlFlagProtocol):
     """Holds (world, turn) authoritatively across runs — the detach/resume
     contract (reference broker globals `world`/`turn`, `Server:29-30`, and
     the `CONT=yes` path, `Local/gol/distributor.go:171-178`)."""
@@ -603,81 +728,6 @@ class Engine:
         self._check_alive()
         return self._snapshot()
 
-    def cf_put(self, flag: int) -> None:
-        """Post a control flag (ref `Server:54-60`)."""
-        self._check_alive()
-        if flag not in (FLAG_PAUSE, FLAG_QUIT, FLAG_KILL):
-            raise ValueError(f"unknown control flag {flag}")
-        self._flags.put(flag)
-
-    def drain_flags(self, pause_only: bool = False) -> None:
-        """Discard STALE control flags — those left by a previous
-        (detached/dead) controller session on a PARKED engine. A no-op
-        while a run is in flight: an attaching observer must not be able
-        to wipe the running controller's pause/quit flags out of the
-        queue (flags are not token-scoped the way abort_run is).
-        Reference analog: the broker's flag channel is emptied by its
-        per-turn sentinel cycle, `Server:136-150`.
-
-        `pause_only` drops only FLAG_PAUSE entries (re-queuing the rest
-        in order): the loss-recovery path uses it because a stranded
-        pause toggle would invert controller-vs-engine pause state on
-        the resubmitted run, while a stranded quit/kill is an idempotent
-        order the resubmitted run SHOULD honour."""
-        self._check_alive()
-        with self._state_lock:
-            if self._running:
-                return
-            # Drain INSIDE the lock: a run starting in the gap between
-            # the check and the drain could have its controller's early
-            # pause/quit flags wiped by this observer (server_distributor
-            # flips _running under the same lock, so holding it here
-            # excludes that window; cf_put itself is queue-safe and
-            # lock-free).
-            kept = []
-            try:
-                while True:
-                    flag = self._flags.get_nowait()
-                    if pause_only and flag != FLAG_PAUSE:
-                        kept.append(flag)
-            except queue.Empty:
-                pass
-            for flag in kept:
-                self._flags.put(flag)
-
-    def kill_prog(self) -> None:
-        """Mark the engine dead (ref `Server:77-80`, worker os.Exit)."""
-        self._killed = True
-
-    def abort_run(self, token: Optional[str] = None) -> bool:
-        """Stop the current run iff `token` matches the run owner's —
-        the recovery takeover after a transient partition (the controller
-        resubmits, finds its pre-partition orphan still computing, and
-        reclaims the engine). No reference counterpart: the Go broker has
-        no way to be reclaimed by a controller that lost it. No-op (False)
-        when idle or when the run belongs to another controller; on abort
-        the state is preserved at the stop point exactly like FLAG_QUIT.
-        A tokenless run cannot be aborted at all (None never matches) —
-        otherwise any peer sending AbortRun with no token could stop a
-        legacy client's run."""
-        self._check_alive()
-        with self._state_lock:
-            if (token is not None and self._running
-                    and self._run_token == token):
-                self._abort.set()
-                return True
-            return False
-
-    def ping(self) -> int:
-        """Liveness probe: the completed turn, with no device work — cheap
-        enough for a sub-second heartbeat. Beyond-reference addition (the
-        reference has no failure detection, SURVEY §5); a killed engine
-        still answers (with EngineKilled), distinguishing 'deliberately
-        down' from 'lost'."""
-        self._check_alive()
-        with self._state_lock:
-            return self._turn
-
     def stats(self) -> dict:
         """Engine telemetry snapshot for operators (no device work):
         completed turn, run state, board geometry, current compiled chunk
@@ -877,10 +927,6 @@ class Engine:
             return None
         return make_mesh2d((r, c), self._devices)
 
-    def _check_alive(self) -> None:
-        if self._killed:
-            raise EngineKilled("engine has been killed")
-
     def _snapshot(self) -> Tuple[np.ndarray, int]:
         with self._state_lock:
             cells, turn, repr_ = self._cells, self._turn, self._repr
@@ -903,9 +949,10 @@ class Engine:
         from gol_tpu.models.generations import to_pixels_gen
 
         if repr_ == "gen3":
-            a = np.asarray(jax.device_get(unpack(cells[0])))
-            d = np.asarray(jax.device_get(unpack(cells[1])))
-            state = (a + 2 * d).astype(np.uint8)
+            # One fused program + one transfer (two eager unpack
+            # dispatches would double snapshot latency on the tunnel —
+            # the same cost note as _padded_row_counts).
+            state = np.asarray(jax.device_get(_gen3_state(cells)))
         else:  # gen8
             state = np.asarray(jax.device_get(cells))
         return to_pixels_gen(state, self._rule)
@@ -1005,30 +1052,3 @@ class Engine:
         if span <= 0 or turns <= 0:
             return None
         return turns / span
-
-    def _handle_flags(self) -> bool:
-        """Drain flags; block while paused. Returns True to quit the run
-        (reference handshake `Server/gol/distributor.go:136-164`)."""
-        paused = False
-        while True:
-            if self._killed or self._abort.is_set():
-                return True
-            try:
-                flag = self._flags.get_nowait() if not paused \
-                    else self._flags.get(timeout=0.05)
-            except queue.Empty:
-                if not paused:
-                    return False
-                continue
-            if flag == FLAG_PAUSE:
-                paused = not paused
-                if not paused:
-                    return False
-            elif flag in (FLAG_QUIT, FLAG_KILL):
-                # Both break the run loop and still return the board to the
-                # controller; on kill the reference broker first downs its
-                # workers then returns, and only dies when the controller
-                # calls KillProg afterwards (`Server:157-164`,
-                # `Local/gol/distributor.go:213-216`). Our "workers" are the
-                # compiled program — nothing to down until kill_prog().
-                return True

@@ -41,12 +41,9 @@ from gol_tpu.engine import (
     CKPT_ENV,
     CKPT_EVERY_DEFAULT,
     CKPT_EVERY_ENV,
-    FLAG_KILL,
-    FLAG_PAUSE,
-    FLAG_QUIT,
     MAX_CHUNK_ENV,
+    ControlFlagProtocol,
     EngineBusy,
-    EngineKilled,
 )
 from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.models.sparse import SparseTorus
@@ -57,7 +54,7 @@ SPARSE_CHUNK_MIN = 64
 SPARSE_CHUNK_MAX = 1 << 16
 
 
-class SparseEngine:
+class SparseEngine(ControlFlagProtocol):
     def __init__(self, size: int, rule=CONWAY) -> None:
         from gol_tpu.models.lifelike import LifeLikeRule
 
@@ -207,49 +204,9 @@ class SparseEngine:
         self._check_alive()
         with self._state_lock:
             pub = self._pub
-            turn = self._turn
         if pub is None:
             raise RuntimeError("no board loaded")
         return self._window_pixels(pub), (pub[1], pub[2]), pub[3]
-
-    def cf_put(self, flag: int) -> None:
-        self._check_alive()
-        if flag not in (FLAG_PAUSE, FLAG_QUIT, FLAG_KILL):
-            raise ValueError(f"unknown control flag {flag}")
-        self._flags.put(flag)
-
-    def drain_flags(self, pause_only: bool = False) -> None:
-        self._check_alive()
-        with self._state_lock:
-            if self._running:
-                return
-            kept = []
-            try:
-                while True:
-                    flag = self._flags.get_nowait()
-                    if pause_only and flag != FLAG_PAUSE:
-                        kept.append(flag)
-            except queue.Empty:
-                pass
-            for flag in kept:
-                self._flags.put(flag)
-
-    def kill_prog(self) -> None:
-        self._killed = True
-
-    def abort_run(self, token: Optional[str] = None) -> bool:
-        self._check_alive()
-        with self._state_lock:
-            if (token is not None and self._running
-                    and self._run_token == token):
-                self._abort.set()
-                return True
-            return False
-
-    def ping(self) -> int:
-        self._check_alive()
-        with self._state_lock:
-            return self._turn
 
     def stats(self) -> dict:
         self._check_alive()
@@ -326,10 +283,6 @@ class SparseEngine:
 
     # ------------------------------------------------------------- internals
 
-    def _check_alive(self) -> None:
-        if self._killed:
-            raise EngineKilled("engine has been killed")
-
     def _publish_locked(self, alive: Optional[int] = None) -> None:
         """Refresh the coherent poll snapshot; caller holds the lock."""
         t = self._torus
@@ -346,23 +299,3 @@ class SparseEngine:
             raise RuntimeError("no board loaded")
         return (np.asarray(jax.device_get(unpack(pub[0])))
                 * np.uint8(255))
-
-    def _handle_flags(self) -> bool:
-        """Identical semantics to the dense engine's flag drain."""
-        paused = False
-        while True:
-            if self._killed or self._abort.is_set():
-                return True
-            try:
-                flag = self._flags.get_nowait() if not paused \
-                    else self._flags.get(timeout=0.05)
-            except queue.Empty:
-                if not paused:
-                    return False
-                continue
-            if flag == FLAG_PAUSE:
-                paused = not paused
-                if not paused:
-                    return False
-            elif flag in (FLAG_QUIT, FLAG_KILL):
-                return True

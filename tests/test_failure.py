@@ -564,6 +564,7 @@ from tests.server_harness import (  # noqa: E402 — shared e2e harness
 )
 
 
+@pytest.mark.timeout(420)
 def test_sigkill_restart_resume_e2e(images_dir, out_dir, tmp_path,
                                     monkeypatch):
     """The full failure-recovery story across real process boundaries:

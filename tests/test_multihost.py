@@ -9,6 +9,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -18,6 +20,7 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.timeout(300)
 def test_server_main_joins_cluster(repo_root):
     """`gol-tpu-server --coordinator …` must initialize jax.distributed
     BEFORE anything touches the XLA backend (regression: the compile-cache
@@ -78,6 +81,7 @@ def test_server_main_joins_cluster(repo_root):
             p.wait(10)
 
 
+@pytest.mark.timeout(360)
 def test_two_process_mesh_evolution(repo_root):
     port = _free_port()
     worker = str(repo_root / "tests" / "multihost_worker.py")

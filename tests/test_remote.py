@@ -289,8 +289,10 @@ def test_stalling_client_is_shed(monkeypatch):
 
 def test_connection_cap(monkeypatch):
     """Thread-pool bound: beyond GOL_MAX_CONNS concurrent connections the
-    server refuses with a 'busy' error instead of spawning unboundedly,
-    and recovers once the hogs disconnect."""
+    server refuses with an 'overloaded:' error (deliberately NOT 'busy:',
+    which the client maps to the fatal-on-first-submission EngineBusy —
+    see server.py's refusal comment) instead of spawning unboundedly, and
+    recovers once the hogs disconnect."""
     import socket
 
     from gol_tpu.wire import recv_msg
@@ -325,6 +327,7 @@ def test_connection_cap(monkeypatch):
         srv.shutdown()
 
 
+@pytest.mark.timeout(360)
 def test_cross_process_detach_reattach(images_dir, out_dir, tmp_path):
     """The flagship resilience story across a REAL process boundary
     (reference `Local/gol/distributor.go:171-178`): controller 1 quits
@@ -332,50 +335,12 @@ def test_cross_process_detach_reattach(images_dir, out_dir, tmp_path):
     SECOND controller with CONT=yes reattaches and finishes; the final
     board equals an uninterrupted run of the same length."""
     import os
-    import re
-    import subprocess
-    import sys
 
-    launcher = (
-        "import os\n"
-        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
-        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
-        "' --xla_force_host_platform_device_count=8'\n"
-        "import jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n"
-        "import sys\n"
-        "sys.argv = ['server', '--port', '0']\n"
-        "from gol_tpu.server import main\n"
-        "main()\n"
-    )
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env.pop("SER", None)
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.Popen(
-        [sys.executable, "-u", "-c", launcher],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        env=env,
-        cwd=str(tmp_path),
-    )
+    from tests.server_harness import spawn_server, wait_port
+
+    proc = spawn_server(0, tmp_path)
     try:
-        # Read the port announcement under a wall-clock deadline (a bare
-        # readline() could block forever if jax init hangs).
-        found = {}
-
-        def _scan_stdout():
-            for line in proc.stdout:
-                m = re.search(r"serving on :(\d+)", line)
-                if m:
-                    found["port"] = int(m.group(1))
-                    return
-
-        scanner = threading.Thread(target=_scan_stdout, daemon=True)
-        scanner.start()
-        scanner.join(120)
-        port = found.get("port")
+        port = wait_port(proc)
         assert port, "server subprocess never announced its port"
 
         from gol_tpu.io.pgm import read_pgm
