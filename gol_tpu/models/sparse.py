@@ -106,6 +106,55 @@ def _round_up(v: int, align: int) -> int:
     return -(-v // align) * align
 
 
+# The sparse engine is single-device BY DESIGN (the live window is one
+# shard); its hard ceiling is the device's HBM. Enforce it with a clear
+# error instead of an allocator OOM deep inside a kernel (r5 — VERDICT
+# r4 #7). GOL_SPARSE_MAX_BYTES overrides the budget (0 disables the
+# check); default is half the device's reported memory limit (kernel
+# temporaries need the rest), falling back to 8 GiB where the platform
+# reports none.
+_MAX_BYTES_ENV = "GOL_SPARSE_MAX_BYTES"
+_DEFAULT_BUDGET = 8 << 30
+# A packed window costs H*W/8 bytes; stepping it needs a handful of
+# same-size temporaries (carry planes, double-buffering).
+_WINDOW_COST_FACTOR = 4
+
+
+def _window_budget() -> int:
+    import os
+
+    v = os.environ.get(_MAX_BYTES_ENV, "")
+    if v:
+        try:
+            n = int(v)
+        except ValueError:
+            n = None  # garbage degrades to the probed default
+        if n is not None:
+            if n > 0:
+                return n
+            if n == 0:
+                return 1 << 62  # exactly 0 disables the guard
+            # Negative values degrade to the default: only an explicit
+            # 0 may disable the OOM guard this budget exists to enforce.
+    from gol_tpu.utils.devicemem import half_device_memory
+
+    return half_device_memory(_DEFAULT_BUDGET)
+
+
+def _check_window_fits(win_h: int, win_w: int) -> None:
+    """Raise a diagnosable error when a window this size cannot run on
+    the single device — BEFORE the allocation that would OOM."""
+    need = win_h * (win_w // 8) * _WINDOW_COST_FACTOR
+    budget = _window_budget()
+    if need > budget:
+        raise RuntimeError(
+            f"sparse window {win_w}x{win_h} needs ~{need / 2**30:.1f} "
+            f"GiB of device memory (> budget {budget / 2**30:.1f} GiB): "
+            f"the pattern has outgrown the single-device sparse engine. "
+            f"Run the dense sharded engine for boards this large, or "
+            f"raise {_MAX_BYTES_ENV}.")
+
+
 def _cyclic_extent(coords, size: int):
     """(origin, extent) of the tightest arc covering `coords` on a
     `size`-cycle: anchor just past the largest gap between consecutive
@@ -157,6 +206,7 @@ class SparseTorus:
         margin = 64
         win_w = min(_round_up(w + 2 * margin, _COL_ALIGN), size)
         win_h = min(_round_up(h + 2 * margin, _ROW_ALIGN), size)
+        _check_window_fits(win_h, win_w)
         # Torus origin of window cell (0, 0); word-aligned columns.
         self._ox = ((x0 - (win_w - w) // 2) // WORD_BITS * WORD_BITS) % size
         self._oy = (y0 - (win_h - h) // 2) % size
@@ -192,7 +242,9 @@ class SparseTorus:
         self.turn = 0
         self._ox = ox % size
         self._oy = oy % size
-        self._packed = jax.device_put(np.asarray(words, dtype=np.uint32))
+        words = np.asarray(words, dtype=np.uint32)
+        _check_window_fits(words.shape[0], words.shape[1] * WORD_BITS)
+        self._packed = jax.device_put(words)
         self._occ = None
         self._margins_host = None
         self._margins_valid = False
@@ -273,6 +325,7 @@ class SparseTorus:
                     self.size)
         new_w = min(_round_up(live_w + 2 * headroom, col_align),
                     self.size)
+        _check_window_fits(new_h, new_w)
         pad_top = (new_h - live_h) // 2
         pad_left_words = ((new_w - live_w) // 2) // WORD_BITS
         new = jnp.zeros((new_h, new_w // WORD_BITS),

@@ -368,25 +368,44 @@ def exact_shard_ext(height: int, n_shards: int) -> int:
     return ext
 
 
-def extend_rows(board: np.ndarray, ext: int) -> np.ndarray:
-    """[B; B[(0:ext) mod H]] — the wrap-extended board (host-side, at
-    submit). Cyclic indexing: when ext > H (tiny boards on wide meshes,
-    e.g. H=2 over 8 shards needs ext=6) the extension is the torus
-    unrolled, not a short slice."""
+def extend_rows(board: np.ndarray, ext: int, axis: int = 0) -> np.ndarray:
+    """[B; B[(0:ext) mod H]] along `axis` — the wrap-extended board
+    (host-side, at submit). Cyclic indexing: when ext > H (tiny boards
+    on wide meshes, e.g. H=2 over 8 shards needs ext=6) the extension is
+    the torus unrolled, not a short slice. `axis=1` serves the stacked
+    gen3 planes (2, H, Wp), whose row axis is not the leading one."""
     import numpy as _np
 
-    idx = _np.arange(ext) % board.shape[0]
-    return _np.concatenate([board, board[idx]], axis=0)
+    idx = _np.arange(ext) % board.shape[axis]
+    return _np.concatenate(
+        [board, _np.take(board, idx, axis=axis)], axis=axis)
+
+
+def _ext_repr(repr_) -> str:
+    """Normalize the representation tag: legacy bool (life-like
+    packed/u8) or one of 'packed'/'u8'/'gen8'/'gen3' (r5 — VERDICT r4
+    #2 extends exact-N to the Generations family)."""
+    if repr_ is True:
+        return "packed"
+    if repr_ is False:
+        return "u8"
+    if repr_ not in ("packed", "u8", "gen8", "gen3"):
+        raise ValueError(f"unknown extended-run repr {repr_!r}")
+    return repr_
 
 
 @functools.lru_cache(maxsize=128)
-def _make_extended_run(height: int, ext: int, packed: bool, mesh: Mesh,
-                       rule: LifeLikeRule):
+def _make_extended_run(height: int, ext: int, repr_: str, mesh: Mesh,
+                       rule):
     """jitted (extended board, num_turns-static) -> extended board:
     torus-step + invariant rebuild per turn, sharded exactly N ways over
-    `mesh` rows via GSPMD (sharding constraint on the scan carry)."""
+    `mesh` rows via GSPMD (sharding constraint on the scan carry). All
+    four representations ride the same rebuild; only the inner full-
+    torus step and the row axis differ (gen3's stacked planes carry
+    rows on axis 1)."""
     from jax.sharding import NamedSharding
 
+    from gol_tpu.models.generations import _packed_step3, _step as gen_step
     from gol_tpu.ops.bitpack import packed_step
     from gol_tpu.ops.stencil import step as u8_step
 
@@ -394,8 +413,20 @@ def _make_extended_run(height: int, ext: int, packed: bool, mesh: Mesh,
         # The rebuild reads P2[H], whose below-neighbour P[H+1] must
         # exist — a smaller extension silently computes garbage.
         raise ValueError(f"wrap extension needs ext >= 2, got {ext}")
-    sh = NamedSharding(mesh, P(ROWS_AXIS, None))
-    inner = packed_step if packed else u8_step
+    axis = 1 if repr_ == "gen3" else 0
+    sh = NamedSharding(
+        mesh,
+        P(None, ROWS_AXIS, None) if repr_ == "gen3" else P(ROWS_AXIS, None))
+
+    def inner(prev):
+        if repr_ == "packed":
+            return packed_step(prev, rule)
+        if repr_ == "u8":
+            return u8_step(prev, rule)
+        if repr_ == "gen8":
+            return gen_step(prev, rule)
+        a2, d2 = _packed_step3(prev[0], prev[1], rule)
+        return jnp.stack([a2, d2])
 
     ext_idx = tuple(range(ext))  # static; cyclic when ext > height
 
@@ -404,11 +435,13 @@ def _make_extended_run(height: int, ext: int, packed: bool, mesh: Mesh,
         idx = jnp.array([i % height for i in ext_idx], dtype=jnp.int32)
 
         def body(prev, _):
-            stepped = inner(prev, rule)
+            stepped = inner(prev)
             core = jnp.concatenate(
-                [stepped[height:height + 1], stepped[1:height]], axis=0)
+                [lax.slice_in_dim(stepped, height, height + 1, axis=axis),
+                 lax.slice_in_dim(stepped, 1, height, axis=axis)],
+                axis=axis)
             nxt = jnp.concatenate(
-                [core, jnp.take(core, idx, axis=0)], axis=0)
+                [core, jnp.take(core, idx, axis=axis)], axis=axis)
             return lax.with_sharding_constraint(nxt, sh), None
 
         out, _ = lax.scan(body, board, None, length=num_turns)
@@ -421,27 +454,31 @@ def extended_run_turns(
     board: jax.Array,
     num_turns: int,
     mesh: Mesh,
-    rule: LifeLikeRule = CONWAY,
+    rule=CONWAY,
     *,
     height: int,
     ext: int,
-    packed: bool,
+    packed,
 ) -> jax.Array:
     """Advance a wrap-extended board (see module note above) — the
-    exact-shard-count path for heights not divisible by the mesh."""
-    return _make_extended_run(height, ext, packed, mesh, rule)(
+    exact-shard-count path for heights not divisible by the mesh.
+    `packed`: a repr tag per `_ext_repr` (bool accepted for the
+    life-like callers)."""
+    return _make_extended_run(height, ext, _ext_repr(packed), mesh, rule)(
         board, num_turns)
 
 
 @functools.lru_cache(maxsize=128)
-def extended_run_fn(height: int, ext: int, packed: bool):
+def extended_run_fn(height: int, ext: int, packed):
     """A stable-identity (cells, k, mesh, rule) run callable for the
     wrap-extension path — cached so the engine's `_tokened_run` lru
     cache keys on one object per (height, ext, tier)."""
+    repr_ = _ext_repr(packed)
+
     def run(cells, num_turns, mesh, rule=CONWAY):
         return extended_run_turns(
             cells, num_turns, mesh, rule,
-            height=height, ext=ext, packed=packed)
+            height=height, ext=ext, packed=repr_)
 
     return run
 

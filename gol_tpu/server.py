@@ -151,6 +151,13 @@ class EngineServer:
             elif method == "GetWorld":
                 out, turn = self.engine.get_world()
                 send_msg(conn, {"ok": True, "turn": turn}, out)
+            elif method == "GetView":
+                # Dense engines: O(max_cells) downsampled live-view
+                # frame (the remote analog of Engine.get_view).
+                out, turn, (fy, fx) = self.engine.get_view(
+                    int(header.get("max_cells", 0)))
+                send_msg(conn, {"ok": True, "turn": turn,
+                                "fy": fy, "fx": fx}, out)
             elif method == "GetWindow":
                 # Sparse engines only: live-window pixels + torus origin.
                 out, (ox, oy), turn = self.engine.get_window()

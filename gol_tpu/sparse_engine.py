@@ -64,6 +64,14 @@ class SparseEngine(ControlFlagProtocol):
             raise ValueError(
                 f"sparse engine supports life-like rules only, "
                 f"got {rule.rulestring!r}")
+        if 0 in rule.born:
+            # Mirror SparseTorus.__init__ (ADVICE r4): without this, a
+            # B0 server starts cleanly and then fails every submit —
+            # and a checkpoint restore would bypass the seed-time check
+            # entirely and evolve silently wrongly.
+            raise ValueError(
+                f"rule {rule.rulestring} births on 0 neighbours; "
+                "use the dense engine")
         if size % WORD_BITS != 0:
             raise ValueError(f"torus size {size} not a multiple of 32")
         self.size = size
@@ -270,8 +278,22 @@ class SparseEngine(ControlFlagProtocol):
             if words.dtype != np.uint32 or words.ndim != 2:
                 raise ValueError(f"{path}: bad words {words.dtype} "
                                  f"{words.shape}")
+            # Window-geometry invariants (ADVICE r4): SparseTorus
+            # establishes window ≤ torus and a word-aligned origin, and
+            # the repositioning machinery assumes both — a forged or
+            # foreign checkpoint must not smuggle a violation past the
+            # dtype checks.
+            ox, oy = int(z["ox"]), int(z["oy"])
+            if (words.shape[0] > self.size
+                    or words.shape[1] * WORD_BITS > self.size):
+                raise ValueError(
+                    f"{path}: window {words.shape[1] * WORD_BITS}x"
+                    f"{words.shape[0]} exceeds torus {self.size}")
+            if ox % WORD_BITS != 0:
+                raise ValueError(
+                    f"{path}: window origin x={ox} is not word-aligned")
             torus = SparseTorus._from_state(
-                self.size, words, int(z["ox"]), int(z["oy"]), self._rule)
+                self.size, words, ox, oy, self._rule)
             turn = int(z["turn"])
         with self._state_lock:
             if self._running:

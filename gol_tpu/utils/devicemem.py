@@ -1,0 +1,25 @@
+"""Device-memory budget probe, shared by every subsystem that sizes
+itself against HBM (the engine's pipeline-depth clamp, the sparse
+engine's window ceiling) so the platform heuristic lives in ONE place.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def half_device_memory(default: int, device=None) -> int:
+    """Half the device's reported memory limit — kernel temporaries and
+    working sets need the other half — or `default` where the platform
+    reports none (e.g. the axon tunnel reports bytes_limit 0)."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        cap = (device.memory_stats() or {}).get("bytes_limit", 0)
+        if cap:
+            return int(cap) // 2
+    except Exception:
+        pass
+    return default

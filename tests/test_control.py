@@ -105,16 +105,23 @@ def test_pause_actually_stops_turns(images_dir, out_dir, monkeypatch):
     time.sleep(1.0)
     keys.put("p")
     # The pause lands at the next chunk boundary; a first-chunk compile
-    # can outlast any fixed sleep, so wait for quiescence (two equal
-    # reads) before asserting the turn stays put.
+    # can outlast any fixed sleep, so wait for SUSTAINED quiescence (a
+    # single equal pair can be a transient compile/load stall on a busy
+    # host, not the pause) before asserting the turn stays put.
     deadline = time.monotonic() + 60
-    _, t1 = engine.alive_count()
+    t1, stable_since = None, None
     while time.monotonic() < deadline:
-        time.sleep(0.5)
         _, t = engine.alive_count()
         if t == t1:
-            break
-        t1 = t
+            if stable_since is None:
+                stable_since = time.monotonic()
+            elif time.monotonic() - stable_since >= 2.5:
+                break
+        else:
+            t1, stable_since = t, None
+        time.sleep(0.5)
+    else:
+        raise AssertionError("engine never quiesced after pause")
     time.sleep(1.5)
     _, t2 = engine.alive_count()
     assert t1 == t2, f"turn advanced while paused: {t1} -> {t2}"
@@ -133,8 +140,12 @@ def test_quit_latency_bound(images_dir, out_dir, monkeypatch):
     adapter keeps chunks in a [0.05, 0.1] s wall band, so a quit on an
     unbounded run must complete in ~0.4 s of engine time — asserted at
     5 s to absorb CI jitter and ramp-tail compiles, still an order of
-    magnitude under the unbounded-regression alternative."""
+    magnitude under the unbounded-regression alternative. GOL_MAX_CHUNK
+    additionally bounds compiled-program size so a cold-cache compile
+    stall or a loaded CI host cannot stretch one chunk past the bound
+    (ADVICE r4: the band alone made this a potential flake)."""
     monkeypatch.setenv("GOL_CHUNK_TARGET", "0.05")
+    monkeypatch.setenv("GOL_MAX_CHUNK", "4096")
     engine = Engine()
     p = Params(threads=1, image_width=64, image_height=64, turns=10**9)
     events_q, keys = queue.Queue(), queue.Queue()

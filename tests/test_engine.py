@@ -283,11 +283,43 @@ def test_engine_full_run_on_2d_mesh(monkeypatch):
     assert turn == 24
     np.testing.assert_array_equal((out != 0).astype(np.uint8), want)
 
-    # 3x3 needs 9 devices on an 8-device mesh: quiet 1-D fallback.
+    # 3x3 needs 9 devices on an 8-device mesh: LOUD 1-D fallback (r5 —
+    # a silent downgrade would leave the operator believing GOL_MESH
+    # took effect), same exact result.
     eng2 = Engine(mesh_shape=(3, 3))
-    assert eng2._resolve_mesh2d(64, 256, True) is None
-    out2, _ = eng2.server_distributor(p, world)
+    with pytest.warns(UserWarning, match="2-D mesh request"):
+        assert eng2._resolve_mesh2d(64, 256, True) is None
+    with pytest.warns(UserWarning, match="falling back to 1-D"):
+        out2, _ = eng2.server_distributor(p, world)
     np.testing.assert_array_equal((out2 != 0).astype(np.uint8), want)
+
+
+def test_mesh2d_fallback_warns_each_reason(monkeypatch):
+    """Every unsatisfiable-2-D-mesh reason warns: device shortfall,
+    non-tiling board, unpacked width, non-positive dims (VERDICT r4 #6);
+    and a Generations engine warns that the request is life-like-only
+    (ADVICE r4)."""
+    from gol_tpu.models.generations import GenerationsRule, to_pixels_gen
+
+    eng = Engine(mesh_shape=(2, 4))
+    with pytest.warns(UserWarning, match="not a whole number"):
+        assert eng._resolve_mesh2d(64, 100, False) is None
+    with pytest.warns(UserWarning, match="does not tile"):
+        assert eng._resolve_mesh2d(63, 256, True) is None
+    with pytest.warns(UserWarning, match="needs 16 devices"):
+        assert Engine(mesh_shape=(4, 4))._resolve_mesh2d(
+            64, 256, True) is None
+    with pytest.warns(UserWarning, match="non-positive"):
+        assert Engine(mesh_shape=(0, 4))._resolve_mesh2d(
+            64, 256, True) is None
+
+    rule = GenerationsRule("/2/3")
+    geng = Engine(rule=rule, mesh_shape=(2, 4))
+    state = np.zeros((16, 32), dtype=np.uint8)
+    state[4, 5:8] = 1
+    p = Params(threads=1, image_width=32, image_height=16, turns=2)
+    with pytest.warns(UserWarning, match="life-like packed boards only"):
+        geng.server_distributor(p, to_pixels_gen(state, rule))
 
 
 def test_gol_mesh_malformed_falls_back(monkeypatch):
@@ -420,6 +452,65 @@ def test_pace_rate_needs_enough_samples():
     eng._pace_window.append((2.0, 64))
     eng._pace_window.append((3.0, 64))
     assert abs(eng._pace_rate() - 64.0) < 1e-9  # 192 turns over 3 s
+
+
+def test_alive_count_poll_is_dispatch_free(monkeypatch):
+    """VERDICT r4 #1: the telemetry poll returns the (alive, turn) pair
+    published at the last chunk boundary with ZERO device work — every
+    dispatching count path is poisoned and the poll must not touch
+    them. The published count is exact for the final turn."""
+    eng = Engine()
+    w = board(64, 64, seed=3)
+    p = Params(threads=2, image_width=64, image_height=64, turns=25)
+    eng.server_distributor(p, w)
+
+    import jax
+
+    import gol_tpu.engine as em
+
+    def boom(*a, **k):
+        raise AssertionError("alive_count dispatched device work")
+
+    monkeypatch.setattr(em.Engine, "_alive_dispatch", staticmethod(boom))
+    monkeypatch.setattr(em, "packed_alive_count", boom)
+    monkeypatch.setattr(em, "alive_count_exact", boom)
+    monkeypatch.setattr(em, "_padded_row_counts", boom)
+    monkeypatch.setattr(jax, "device_get", boom)
+    alive, t = eng.alive_count()
+    assert t == 25
+    want = run_turns_np((w != 0).astype(np.uint8), 25)
+    assert alive == int(want.sum())
+
+
+def test_alive_pairs_exact_at_turn_mid_run(monkeypatch):
+    """Every (alive, turn) pair a concurrent poller observes — turn-0
+    publication, chunk boundaries, final — is exact for its turn
+    (reference mutex-coherent pair, `Server:131-134`), including on the
+    wrap-extension exact-N path (pad rows must never be counted)."""
+    monkeypatch.setenv("GOL_MAX_CHUNK", "4")
+    eng = Engine()
+    w = board(17, 64, seed=9)  # prime height x 3 shards -> pad rows
+    p = Params(threads=3, image_width=64, image_height=17, turns=300)
+    pairs = []
+    t = threading.Thread(
+        target=lambda: eng.server_distributor(p, w), daemon=True)
+    t.start()
+    while eng._alive_pub is None and t.is_alive():
+        time.sleep(0.001)  # board not yet installed: (0, 0) is pre-state
+    while t.is_alive():
+        pairs.append(eng.alive_count())
+        time.sleep(0.01)
+    t.join(30)
+    pairs.append(eng.alive_count())
+    w01 = (w != 0).astype(np.uint8)
+    counts = {0: int(w01.sum())}
+    cur = w01
+    for turn in range(1, 301):
+        cur = run_turns_np(cur, 1)
+        counts[turn] = int(cur.sum())
+    assert pairs[-1] == (counts[300], 300)
+    for alive, turn in set(pairs):
+        assert alive == counts[turn], f"pair ({alive}, {turn}) not exact"
 
 
 def test_drain_flags_pause_only_preserves_orders():

@@ -208,16 +208,25 @@ def test_gen_pause_snapshot_ticker(tmp_path, out_dir, monkeypatch):
         jnp.asarray(state0), tick.completed_turns, rule))
     assert tick.cells_count == int((want == 1).sum())
 
-    # pause parks the turn counter
+    # pause parks the turn counter. Quiescence = the published turn
+    # stable for a SUSTAINED window (a single equal pair can be a
+    # transient compile/load stall on a busy CI host, not the pause —
+    # the r5 suite caught exactly that false-quiesce).
     keys.put("p")
     deadline = time.monotonic() + 60
-    _, t1 = engine.alive_count()
+    t1, stable_since = None, None
     while time.monotonic() < deadline:
-        time.sleep(0.4)
         _, t = engine.alive_count()
         if t == t1:
-            break
-        t1 = t
+            if stable_since is None:
+                stable_since = time.monotonic()
+            elif time.monotonic() - stable_since >= 2.5:
+                break
+        else:
+            t1, stable_since = t, None
+        time.sleep(0.4)
+    else:
+        raise AssertionError("engine never quiesced after pause")
     time.sleep(1.0)
     _, t2 = engine.alive_count()
     assert t1 == t2, "turn advanced while paused"

@@ -63,6 +63,17 @@ def test_remote_rpc_surface(server):
     snap, turn = eng.get_world()
     np.testing.assert_array_equal(snap, out)
 
+    # GetView round trip (r5): full frame under the cap, a bounded
+    # downsampled frame above it — byte-identical to the local engine's.
+    vfull, vt, vf = eng.get_view(64 * 32)
+    assert vt == 10 and vf == (1, 1)
+    np.testing.assert_array_equal(vfull, out)
+    vsmall, _, (fy, fx) = eng.get_view(128)
+    assert fy > 1 and vsmall.size <= 128
+    lview, _, lf = server.engine.get_view(128)
+    assert (fy, fx) == lf
+    np.testing.assert_array_equal(vsmall, lview)
+
     # resume path: remaining turns with explicit start_turn
     p2 = Params(threads=2, image_width=64, image_height=32, turns=5)
     out2, turn2 = eng.server_distributor(p2, snap, start_turn=turn)

@@ -67,13 +67,16 @@ class FakeSDL:
         if not self.pending_events:
             return 0
         etype, sym = self.pending_events.pop(0)
-        # write through the byref() into Window.poll_event's 64-byte
-        # event buffer: etype (u32) at offset 0, keysym.sym (i32) at
-        # offset 20 — the exact layout the decoder relies on
+        # Write RAW BYTES through the byref() at the SDL2 wire offsets —
+        # etype (u32) at byte 0, keysym.sym (i32) at byte 20 — exactly
+        # as the real library would. The decoder reads them back through
+        # the declared _SDL_Event union, so this test pins that the
+        # ctypes struct layout matches the SDL2 x86-64 ABI.
         buf = ev_ref._obj
-        ctypes.memset(buf, 0, 64)
-        struct.pack_into("<I", buf, 0, etype)
-        struct.pack_into("<i", buf, 20, sym)
+        ctypes.memset(ctypes.byref(buf), 0, ctypes.sizeof(buf))
+        raw = (ctypes.c_uint8 * ctypes.sizeof(buf)).from_buffer(buf)
+        struct.pack_into("<I", raw, 0, etype)
+        struct.pack_into("<i", raw, 20, sym)
         return 1
 
 
@@ -133,6 +136,23 @@ def test_poll_event_keysym_offset_decode(fake_sdl):
     assert w.poll_event() == "quit"
     # empty queue
     assert w.poll_event() is None
+
+
+def test_event_structs_match_sdl2_abi():
+    """The declared ctypes structures must reproduce SDL2's documented
+    layout: keysym at byte 16 of SDL_KeyboardEvent, sym at byte 4 of
+    SDL_Keysym — i.e. the sym the decoder reads sits at byte 20 of the
+    event, which is where every SDL2 build on this ABI writes it."""
+    from gol_tpu.sdl.window import (
+        _SDL_Event,
+        _SDL_KeyboardEvent,
+        _SDL_Keysym,
+    )
+
+    assert _SDL_KeyboardEvent.keysym.offset == 16
+    assert _SDL_Keysym.sym.offset == 4
+    assert _SDL_Event.key.offset == 0
+    assert ctypes.sizeof(_SDL_Event) >= 56  # SDL2's union size
 
 
 def test_close_sequence(fake_sdl):

@@ -404,6 +404,52 @@ def test_sparse_sigkill_restart_resume_e2e(tmp_path, out_dir, monkeypatch):
                 proc.wait(10)
 
 
+def test_sparse_engine_rejects_b0_at_construction():
+    """ADVICE r4: a B0 rule must fail at SparseEngine construction (so
+    'gol-tpu-server --sparse --rule B03/S23' dies at startup), not at
+    the first seed submit."""
+    from gol_tpu.models.lifelike import LifeLikeRule
+    from gol_tpu.sparse_engine import SparseEngine
+
+    with pytest.raises(ValueError, match="births on 0 neighbours"):
+        SparseEngine(1024, rule=LifeLikeRule("B03/S23"))
+
+
+def test_sparse_checkpoint_geometry_validated(tmp_path):
+    """ADVICE r4: a checkpoint whose window exceeds the torus or whose
+    origin is not word-aligned is rejected — the repositioning
+    machinery assumes both invariants."""
+    import numpy as np
+
+    from gol_tpu.sparse_engine import SparseEngine
+
+    def write(path, words, ox=0, oy=0, size=1024):
+        np.savez(path, sparse_words=words, ox=ox, oy=oy, size=size,
+                 turn=3, rulestring="B3/S23")
+
+    eng = SparseEngine(1024)
+    good = np.zeros((256, 8), dtype=np.uint32)
+    good[10, 2] = 7
+    p = str(tmp_path / "ok.npz")
+    write(p, good)
+    assert eng.load_checkpoint(p) == 3
+
+    wide = str(tmp_path / "wide.npz")
+    write(wide, np.zeros((256, 64), dtype=np.uint32))  # 2048 > 1024
+    with pytest.raises(ValueError, match="exceeds torus"):
+        eng.load_checkpoint(wide)
+
+    tall = str(tmp_path / "tall.npz")
+    write(tall, np.zeros((2048, 8), dtype=np.uint32))
+    with pytest.raises(ValueError, match="exceeds torus"):
+        eng.load_checkpoint(tall)
+
+    skew = str(tmp_path / "skew.npz")
+    write(skew, good, ox=17)
+    with pytest.raises(ValueError, match="not word-aligned"):
+        eng.load_checkpoint(skew)
+
+
 def test_sparse_flag_protocol_direct():
     """Stranded-flag semantics match the dense engine: drain wipes a
     parked engine's queue; pause_only keeps a quit; kill_prog kills."""
