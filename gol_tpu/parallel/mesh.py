@@ -8,10 +8,13 @@ the available chips and shard the board's row axis. The worker-address list
 count*; goroutine `Threads` parallelism is subsumed by XLA within a chip.
 
 Non-divisible heights: the reference spreads `H mod N` remainder rows across
-the first strips (`Server:106-116`). Equal-shape sharding can't do that, and
-padding would break the torus, so the policy (documented, SURVEY §7 hard
-part 3) is: use the largest shard count ≤ requested that divides H. All
-benchmark boards (16..65536) are powers of two, where this is the identity.
+the first strips (`Server:106-116`). The ENGINE serves such requests at the
+exact count via wrap-extension (`parallel/halo.py`, r4/r5 — no divisor
+fallback remains there, for any rule family); `resolve_shard_count` below is
+the divisor POLICY utility for callers that want equal shards without the
+extension's per-turn seam traffic (the bench harness sharding a board over
+however many devices exist; all benchmark boards are powers of two, where
+the downgrade is the identity).
 """
 
 from __future__ import annotations
@@ -28,9 +31,9 @@ ROWS_AXIS = "rows"
 def resolve_shard_count(height: int, requested: int) -> int:
     """Largest n ≤ requested with height % n == 0 (and n ≥ 1). A downgrade
     (non-divisor request, e.g. 7 shards for a 512-row board) is served at
-    the reduced count and warned about — the reference instead spreads
-    remainder rows (`Server:106-116`), so a user coming from it would
-    otherwise silently lose parallelism."""
+    the reduced count and warned about. NOT used by the engine (which
+    serves non-divisor requests exactly via wrap-extension); this is the
+    equal-shards policy for direct kernel users like the bench harness."""
     if requested < 1:
         raise ValueError(f"shard request must be >= 1, got {requested}")
     n = max(1, min(requested, height))

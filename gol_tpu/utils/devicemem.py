@@ -5,8 +5,6 @@ engine's window ceiling) so the platform heuristic lives in ONE place.
 
 from __future__ import annotations
 
-from typing import Optional
-
 
 def half_device_memory(default: int, device=None) -> int:
     """Half the device's reported memory limit — kernel temporaries and

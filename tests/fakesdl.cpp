@@ -1,0 +1,107 @@
+// Miniature libSDL2 stand-in, compiled by the TEST SUITE with the host
+// C++ compiler (tests/test_sdl_cabi.py). Purpose: pin gol_tpu/sdl/
+// window.py's ctypes event structures against the layout a real C
+// compiler produces for SDL2's declarations — the fake-lib Python test
+// writes bytes at offsets it computed itself, while this library fills
+// an actual C union member-by-member, so a ctypes/ABI disagreement
+// fails here even with no real libSDL2 in the image.
+//
+// The struct declarations mirror SDL2's SDL_keyboard.h / SDL_events.h
+// (reference consumer: /root/reference/Local/sdl/window.go:54-66 reads
+// the same keysym through cgo).
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+extern "C" {
+
+typedef struct {
+    int32_t scancode;
+    int32_t sym;
+    uint16_t mod;
+    uint32_t unused;
+} SDL_Keysym;
+
+typedef struct {
+    uint32_t type;
+    uint32_t timestamp;
+    uint32_t windowID;
+    uint8_t state;
+    uint8_t repeat;
+    uint8_t padding2;
+    uint8_t padding3;
+    SDL_Keysym keysym;
+} SDL_KeyboardEvent;
+
+typedef union {
+    uint32_t type;
+    SDL_KeyboardEvent key;
+    uint8_t padding[56];
+} SDL_Event;
+
+#define QUEUE_MAX 64
+static SDL_Event g_queue[QUEUE_MAX];
+static int g_head = 0, g_len = 0;
+
+// --- test-driver surface (not part of SDL) ---------------------------
+
+void fake_push_key(int32_t sym) {
+    if (g_len >= QUEUE_MAX) return;
+    SDL_Event *e = &g_queue[(g_head + g_len++) % QUEUE_MAX];
+    memset(e, 0, sizeof *e);
+    e->key.type = 0x300; // SDL_KEYDOWN
+    e->key.state = 1;
+    e->key.keysym.sym = sym;
+}
+
+void fake_push_quit(void) {
+    if (g_len >= QUEUE_MAX) return;
+    SDL_Event *e = &g_queue[(g_head + g_len++) % QUEUE_MAX];
+    memset(e, 0, sizeof *e);
+    e->type = 0x100; // SDL_QUIT
+}
+
+int fake_sizeof_event(void) { return (int)sizeof(SDL_Event); }
+int fake_offsetof_sym(void) {
+    return (int)(offsetof(SDL_KeyboardEvent, keysym)
+                 + offsetof(SDL_Keysym, sym));
+}
+
+// --- the SDL surface Window uses -------------------------------------
+
+int SDL_Init(uint32_t flags) { (void)flags; return 0; }
+
+static int g_dummy;
+void *SDL_CreateWindow(const char *t, int x, int y, int w, int h,
+                       uint32_t f) {
+    (void)t; (void)x; (void)y; (void)w; (void)h; (void)f;
+    return &g_dummy;
+}
+void *SDL_CreateRenderer(void *w, int i, uint32_t f) {
+    (void)w; (void)i; (void)f; return &g_dummy;
+}
+void *SDL_CreateTexture(void *r, uint32_t fmt, int a, int w, int h) {
+    (void)r; (void)fmt; (void)a; (void)w; (void)h; return &g_dummy;
+}
+int SDL_UpdateTexture(void *t, const void *rect, const void *px,
+                      int pitch) {
+    (void)t; (void)rect; (void)px; (void)pitch; return 0;
+}
+int SDL_RenderClear(void *r) { (void)r; return 0; }
+int SDL_RenderCopy(void *r, void *t, const void *s, const void *d) {
+    (void)r; (void)t; (void)s; (void)d; return 0;
+}
+void SDL_RenderPresent(void *r) { (void)r; }
+void SDL_DestroyWindow(void *w) { (void)w; }
+void SDL_Quit(void) {}
+
+int SDL_PollEvent(SDL_Event *out) {
+    if (!g_len) return 0;
+    *out = g_queue[g_head];
+    g_head = (g_head + 1) % QUEUE_MAX;
+    g_len--;
+    return 1;
+}
+
+} // extern "C"
