@@ -76,14 +76,24 @@ def _ladder_floor(v: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _fused_run(shape, num_turns: int, rule: LifeLikeRule, kind: str):
+def _fused_run(shape, num_turns: int, rule: LifeLikeRule, kind: str,
+               mesh=None):
     """jitted (packed) -> (packed', row_occupancy, col_word_occupancy):
-    `num_turns` torus turns with the `kind` single-device engine, plus the
-    popcount occupancy reductions of the RESULT — all one XLA program, so
-    an adaptive macro-step costs exactly one host round trip."""
-    from gol_tpu.parallel.halo import packed_run_by_kind
+    `num_turns` torus turns with the `kind` single-device engine — or,
+    on a sharded window (`mesh`, r5), the deep-halo ppermute ring with
+    per-shard kernels — plus the popcount occupancy reductions of the
+    RESULT: all one XLA program, so an adaptive macro-step costs
+    exactly one host round trip."""
+    from gol_tpu.parallel.halo import (
+        packed_run_by_kind,
+        sharded_packed_run_turns,
+    )
 
-    step = packed_run_by_kind(kind)
+    if mesh is not None:
+        def step(p, k, r):
+            return sharded_packed_run_turns(p, k, mesh, r)
+    else:
+        step = packed_run_by_kind(kind)
 
     @jax.jit
     def run(packed: jax.Array):
@@ -106,13 +116,13 @@ def _round_up(v: int, align: int) -> int:
     return -(-v // align) * align
 
 
-# The sparse engine is single-device BY DESIGN (the live window is one
-# shard); its hard ceiling is the device's HBM. Enforce it with a clear
-# error instead of an allocator OOM deep inside a kernel (r5 — VERDICT
-# r4 #7). GOL_SPARSE_MAX_BYTES overrides the budget (0 disables the
-# check); default is half the device's reported memory limit (kernel
-# temporaries need the rest), falling back to 8 GiB where the platform
-# reports none.
+# The live window defaults to one device; its hard ceiling is HBM —
+# per device when the window is row-sharded over a mesh (r5). Enforce
+# it with a clear error instead of an allocator OOM deep inside a
+# kernel (r5 — VERDICT r4 #7). GOL_SPARSE_MAX_BYTES overrides the
+# per-device budget (0 disables the check); default is half the
+# device's reported memory limit (kernel temporaries need the rest),
+# falling back to 8 GiB where the platform reports none.
 _MAX_BYTES_ENV = "GOL_SPARSE_MAX_BYTES"
 _DEFAULT_BUDGET = 8 << 30
 # A packed window costs H*W/8 bytes; stepping it needs a handful of
@@ -141,18 +151,36 @@ def _window_budget() -> int:
     return half_device_memory(_DEFAULT_BUDGET)
 
 
-def _check_window_fits(win_h: int, win_w: int) -> None:
+def check_sparse_mesh(n: int, size: int) -> None:
+    """Validate a sparse-window shard count against the invariants the
+    repositioning machinery assumes: every window height is a multiple
+    of _ROW_ALIGN or the full torus, so `n` must divide both. ONE
+    validator shared by SparseTorus.__init__, checkpoint restore, and
+    SparseEngine construction — a bad count must fail at startup, not
+    as an opaque sharding error mid-run."""
+    if n > 1 and (_ROW_ALIGN % n or size % n):
+        raise ValueError(
+            f"sparse mesh of {n} devices must divide "
+            f"{_ROW_ALIGN} and the torus size {size}")
+
+
+def _check_window_fits(win_h: int, win_w: int,
+                       n_devices: int = 1) -> None:
     """Raise a diagnosable error when a window this size cannot run on
-    the single device — BEFORE the allocation that would OOM."""
-    need = win_h * (win_w // 8) * _WINDOW_COST_FACTOR
+    the available devices — BEFORE the allocation that would OOM. A
+    sharded window (r5) divides its bytes over `n_devices`, raising the
+    ceiling proportionally."""
+    need = win_h * (win_w // 8) * _WINDOW_COST_FACTOR // max(n_devices, 1)
     budget = _window_budget()
     if need > budget:
+        hint = ("shard the window over more devices "
+                "(SparseTorus mesh / GOL_SPARSE_SHARDS), run the dense "
+                "sharded engine, or raise " + _MAX_BYTES_ENV)
         raise RuntimeError(
             f"sparse window {win_w}x{win_h} needs ~{need / 2**30:.1f} "
-            f"GiB of device memory (> budget {budget / 2**30:.1f} GiB): "
-            f"the pattern has outgrown the single-device sparse engine. "
-            f"Run the dense sharded engine for boards this large, or "
-            f"raise {_MAX_BYTES_ENV}.")
+            f"GiB per device (> budget {budget / 2**30:.1f} GiB) on "
+            f"{n_devices} device(s): the pattern has outgrown this "
+            f"sparse engine — {hint}.")
 
 
 def _cyclic_extent(coords, size: int):
@@ -177,9 +205,18 @@ class SparseTorus:
         size: int,
         cells: Iterable[Tuple[int, int]],
         rule: LifeLikeRule = CONWAY,
+        mesh=None,
     ) -> None:
+        """`mesh` (r5 — VERDICT r4 weak #6): an optional 1-D
+        `jax.sharding.Mesh` to ROW-SHARD the live window over, raising
+        the single-device HBM ceiling by the device count; stepping
+        rides the same deep-halo ppermute ring as the dense engine.
+        None (default) keeps the single-device fast path."""
         if size % WORD_BITS != 0:
             raise ValueError(f"torus size {size} not a multiple of 32")
+        self._mesh = mesh if (mesh is not None and mesh.size > 1) else None
+        if self._mesh is not None:
+            check_sparse_mesh(self._mesh.size, size)
         if 0 in rule.born:
             # A B0 rule births cells in empty space: the whole torus is
             # active and a live-bounding window is meaningless.
@@ -206,14 +243,14 @@ class SparseTorus:
         margin = 64
         win_w = min(_round_up(w + 2 * margin, _COL_ALIGN), size)
         win_h = min(_round_up(h + 2 * margin, _ROW_ALIGN), size)
-        _check_window_fits(win_h, win_w)
+        _check_window_fits(win_h, win_w, self._n_devices())
         # Torus origin of window cell (0, 0); word-aligned columns.
         self._ox = ((x0 - (win_w - w) // 2) // WORD_BITS * WORD_BITS) % size
         self._oy = (y0 - (win_h - h) // 2) % size
         board = np.zeros((win_h, win_w), dtype=np.uint8)
         for x, y in zip(xs, ys):
             board[(y - self._oy) % size, (x - self._ox) % size] = 1
-        self._packed = jax.device_put(pack(board))
+        self._packed = self._place(pack(board))
         # (row, col-word) popcount occupancy of `_packed`, as device
         # arrays — refreshed for free by every fused macro-step.
         self._occ = None
@@ -224,6 +261,18 @@ class SparseTorus:
         self._margins_host: Optional[Tuple[int, int, int, int]] = None
         self._margins_valid = False
 
+    def _n_devices(self) -> int:
+        return self._mesh.size if self._mesh is not None else 1
+
+    def _place(self, arr) -> jax.Array:
+        """Install a window array on the device(s): row-sharded over the
+        mesh when one is set, plain device_put otherwise."""
+        if self._mesh is not None:
+            from gol_tpu.parallel.mesh import board_sharding
+
+            return jax.device_put(arr, board_sharding(self._mesh))
+        return jax.device_put(arr)
+
     @classmethod
     def _from_state(
         cls,
@@ -232,6 +281,7 @@ class SparseTorus:
         ox: int,
         oy: int,
         rule: LifeLikeRule = CONWAY,
+        mesh=None,
     ) -> "SparseTorus":
         """Rebuild a torus from checkpointed window state (packed words +
         torus origin) without re-deriving it from a cell list — the
@@ -240,11 +290,19 @@ class SparseTorus:
         self.size = size
         self.rule = rule
         self.turn = 0
+        self._mesh = mesh if (mesh is not None and mesh.size > 1) else None
+        if self._mesh is not None:
+            check_sparse_mesh(self._mesh.size, size)
         self._ox = ox % size
         self._oy = oy % size
         words = np.asarray(words, dtype=np.uint32)
-        _check_window_fits(words.shape[0], words.shape[1] * WORD_BITS)
-        self._packed = jax.device_put(words)
+        if self._mesh is not None and words.shape[0] % self._mesh.size:
+            raise ValueError(
+                f"checkpoint window of {words.shape[0]} rows does not "
+                f"split over {self._mesh.size} devices")
+        _check_window_fits(words.shape[0], words.shape[1] * WORD_BITS,
+                           self._n_devices())
+        self._packed = self._place(words)
         self._occ = None
         self._margins_host = None
         self._margins_valid = False
@@ -325,7 +383,7 @@ class SparseTorus:
                     self.size)
         new_w = min(_round_up(live_w + 2 * headroom, col_align),
                     self.size)
-        _check_window_fits(new_h, new_w)
+        _check_window_fits(new_h, new_w, self._n_devices())
         pad_top = (new_h - live_h) // 2
         pad_left_words = ((new_w - live_w) // 2) // WORD_BITS
         new = jnp.zeros((new_h, new_w // WORD_BITS),
@@ -334,6 +392,11 @@ class SparseTorus:
         src = src[:, left // WORD_BITS: wp - right // WORD_BITS]
         new = lax.dynamic_update_slice(
             new, src, (pad_top, pad_left_words))
+        if self._mesh is not None:
+            # Re-establish the row sharding the eager reposition may
+            # have collapsed (the async episode chain then stays fully
+            # on the mesh).
+            new = self._place(new)
         self._ox = (self._ox + left - pad_left_words * WORD_BITS) \
             % self.size
         self._oy = (self._oy + top - pad_top) % self.size
@@ -378,9 +441,13 @@ class SparseTorus:
         """Dispatch one fused k-turn macro-step asynchronously."""
         from gol_tpu.parallel.halo import packed_run_kind
 
-        platform = next(iter(self._packed.devices())).platform
-        kind = packed_run_kind(self._packed.shape, platform)
-        run = _fused_run(self._packed.shape, k, self.rule, kind)
+        if self._mesh is not None:
+            kind = "sharded"
+        else:
+            platform = next(iter(self._packed.devices())).platform
+            kind = packed_run_kind(self._packed.shape, platform)
+        run = _fused_run(self._packed.shape, k, self.rule, kind,
+                         self._mesh)
         self._packed, rows, cols = run(self._packed)
         self._occ = (rows, cols)
         self._margins_valid = False

