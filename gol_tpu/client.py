@@ -187,9 +187,9 @@ class RemoteEngine:
         return world, int(resp["turn"])
 
     def get_view(self, max_cells: int):
-        """Dense engines: (view pixels, turn, (fy, fx)) — the full board
-        when it fits max_cells, else a server-side downsampled frame
-        whose transfer is O(max_cells)."""
+        """(view pixels, turn, (fy, fx)) — the full board (dense) or
+        live window (sparse) when it fits max_cells, else a server-side
+        downsampled frame whose transfer is O(max_cells)."""
         resp, view = self._call(
             {"method": "GetView", "max_cells": int(max_cells)},
             timeout=self._timeout)

@@ -173,6 +173,16 @@ def _padded_row_counts(repr_: str, pad: int):
     return rows
 
 
+def view_factor(h: int, w: int, max_cells: int) -> int:
+    """Smallest integer downsample factor f with
+    ceil(h/f) * ceil(w/f) <= max_cells — the ONE factor rule shared by
+    the dense and sparse GetView implementations."""
+    f = max(1, int(np.ceil(np.sqrt(h * w / max_cells))))
+    while -(-h // f) * -(-w // f) > max_cells:
+        f += 1
+    return f
+
+
 @functools.lru_cache(maxsize=32)
 def _view_program(repr_: str, pad: int, f: int, rule):
     """Cached jit: board state -> (ceil(H/f), ceil(W/f)) uint8 pixel
@@ -948,9 +958,7 @@ class Engine(ControlFlagProtocol):
         w = _board_width(cells, repr_)
         if max_cells <= 0 or h * w <= max_cells:
             return self._materialize(cells, repr_, pad), turn, (1, 1)
-        f = max(1, int(np.ceil(np.sqrt(h * w / max_cells))))
-        while -(-h // f) * -(-w // f) > max_cells:
-            f += 1
+        f = view_factor(h, w, max_cells)
         view = np.asarray(jax.device_get(
             _view_program(repr_, pad, f, self._rule)(cells)))
         return view, turn, (f, f)
@@ -976,6 +984,13 @@ class Engine(ControlFlagProtocol):
                 "turn": self._turn,
                 "running": self._running,
                 "board": shape,
+                # Last published firing count (exact at "alive_turn",
+                # which can trail "turn" by the in-flight chunks) —
+                # free operator telemetry from the r5 publication.
+                "alive": (self._alive_pub[0]
+                          if self._alive_pub is not None else None),
+                "alive_turn": (self._alive_pub[1]
+                               if self._alive_pub is not None else None),
                 "packed": self._packed,
                 "chunk": self._last_chunk,
                 "turns_per_s": round(self._turns_per_s, 1),

@@ -152,8 +152,9 @@ class EngineServer:
                 out, turn = self.engine.get_world()
                 send_msg(conn, {"ok": True, "turn": turn}, out)
             elif method == "GetView":
-                # Dense engines: O(max_cells) downsampled live-view
-                # frame (the remote analog of Engine.get_view).
+                # O(max_cells) downsampled live-view frame of the board
+                # (dense) or live window (sparse) — the remote analog
+                # of the engines' get_view.
                 out, turn, (fy, fx) = self.engine.get_view(
                     int(header.get("max_cells", 0)))
                 send_msg(conn, {"ok": True, "turn": turn,

@@ -239,12 +239,36 @@ class SparseEngine(ControlFlagProtocol):
             raise RuntimeError("no board loaded")
         return self._window_pixels(pub), (pub[1], pub[2]), pub[3]
 
+    def get_view(
+        self, max_cells: int
+    ) -> Tuple[np.ndarray, int, Tuple[int, int]]:
+        """(window view, turn, (fy, fx)): the live window when it fits
+        `max_cells`, else an on-device block-any-alive reduction — the
+        same O(viewport) contract as the dense engine's GetView (a
+        grown window is budget-bounded, not small: it can be GBs)."""
+        self._check_alive()
+        with self._state_lock:
+            pub = self._pub
+        if pub is None:
+            raise RuntimeError("no board loaded")
+        packed, _, _, turn, _ = pub
+        h, w = packed.shape[0], packed.shape[1] * WORD_BITS
+        if max_cells <= 0 or h * w <= max_cells:
+            return self._window_pixels(pub), turn, (1, 1)
+        from gol_tpu.engine import _view_program, view_factor
+
+        f = view_factor(h, w, max_cells)
+        view = np.asarray(jax.device_get(
+            _view_program("packed", 0, f, self._rule)(packed)))
+        return view, turn, (f, f)
+
     def stats(self) -> dict:
         self._check_alive()
         with self._state_lock:
             window = origin = None
+            alive = alive_turn = None
             if self._pub is not None:
-                packed, ox, oy, _, _ = self._pub
+                packed, ox, oy, alive_turn, alive = self._pub
                 h, wp = packed.shape
                 window, origin = [h, wp * WORD_BITS], [ox, oy]
             return {
@@ -253,6 +277,8 @@ class SparseEngine(ControlFlagProtocol):
                 "board": [self.size, self.size],
                 "window": window,
                 "origin": origin,
+                "alive": alive,
+                "alive_turn": alive_turn,
                 "packed": True,
                 "sparse": True,
                 "chunk": self._last_chunk,

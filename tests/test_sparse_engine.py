@@ -404,6 +404,37 @@ def test_sparse_sigkill_restart_resume_e2e(tmp_path, out_dir, monkeypatch):
                 proc.wait(10)
 
 
+def test_sparse_get_view_and_stats_alive():
+    """r5: the sparse engine serves the same GetView contract as the
+    dense engine (full window under the cap, on-device block-any-alive
+    above it — a grown window can be GBs), and Stats reports the
+    published firing count."""
+    from gol_tpu.params import Params
+    from gol_tpu.sparse_engine import SparseEngine
+
+    seed = np.zeros((8, 8), dtype=np.uint8)
+    for x, y in ((1, 0), (2, 0), (0, 1), (1, 1), (1, 2)):
+        seed[y + 2, x + 2] = 255
+    eng = SparseEngine(2**20)
+    p = Params(threads=1, image_width=2**20, image_height=2**20,
+               turns=64)
+    eng.server_distributor(p, seed)
+    full, turn, f = eng.get_view(1 << 62)
+    assert f == (1, 1) and turn == 64
+    np.testing.assert_array_equal(full, eng.get_world()[0])
+    small, _, (fy, fx) = eng.get_view(4096)
+    assert fy == fx and fy > 1 and small.size <= 4096
+    # downsample oracle: brightest pixel of each block
+    h, w = full.shape
+    hp, wp = -(-h // fy) * fy, -(-w // fx) * fx
+    padded = np.zeros((hp, wp), dtype=full.dtype)
+    padded[:h, :w] = full
+    want = padded.reshape(hp // fy, fy, wp // fx, fx).max(axis=(1, 3))
+    np.testing.assert_array_equal(small, want)
+    s = eng.stats()
+    assert s["alive"] == eng.alive_count()[0]
+
+
 def test_sparse_engine_rejects_b0_at_construction():
     """ADVICE r4: a B0 rule must fail at SparseEngine construction (so
     'gol-tpu-server --sparse --rule B03/S23' dies at startup), not at
