@@ -245,7 +245,15 @@ class SparseEngine(ControlFlagProtocol):
         """(window view, turn, (fy, fx)): the live window when it fits
         `max_cells`, else an on-device block-any-alive reduction — the
         same O(viewport) contract as the dense engine's GetView (a
-        grown window is budget-bounded, not small: it can be GBs)."""
+        grown window is budget-bounded, not small: it can be GBs).
+
+        Frames are WINDOW-anchored, and the window's torus origin moves
+        as the pattern grows — consecutive frames of equal shape are
+        NOT diffable against each other (unlike the dense engine's
+        board-anchored frames). Incremental consumers must anchor on
+        `get_window()`'s origin; the distributor's diffing live view
+        stays disabled for sparse runs for exactly this reason
+        (`distributor.py` sparse live_view guard)."""
         self._check_alive()
         with self._state_lock:
             pub = self._pub
