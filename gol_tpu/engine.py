@@ -47,7 +47,7 @@ import jax
 import numpy as np
 
 from gol_tpu.models.generations import GenerationsRule
-from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.ops.bitpack import pack, packed_alive_count, unpack
 from gol_tpu.ops.stencil import alive_count_exact, from_pixels, to_pixels
 from gol_tpu.params import Params

@@ -282,6 +282,9 @@ def test_engine_full_run_on_2d_mesh(monkeypatch):
     out, turn = eng.server_distributor(p, world)
     assert turn == 24
     np.testing.assert_array_equal((out != 0).astype(np.uint8), want)
+    # The alive publication (r5 chunk token) is exact on the 2-D mesh
+    # too — the binned row counts reduce across BOTH mesh axes.
+    assert eng.alive_count() == (int(want.sum()), 24)
 
     # 3x3 needs 9 devices on an 8-device mesh: LOUD 1-D fallback (r5 —
     # a silent downgrade would leave the operator believing GOL_MESH
