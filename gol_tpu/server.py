@@ -213,7 +213,9 @@ def main() -> None:
                          "cells (SIZE %% 32 == 0, e.g. 1048576 = 2^20) "
                          "instead of the dense engine: runs are seeded "
                          "by a small pattern board, snapshots are the "
-                         "live window (life-like rules only)")
+                         "live window (life-like rules only; "
+                         "GOL_SPARSE_SHARDS row-shards the window over "
+                         "that many devices)")
     args = ap.parse_args()
     # Join the multi-host engine cluster FIRST: jax.distributed must
     # initialize before ANYTHING touches the XLA backend (including the
