@@ -633,9 +633,10 @@ def main() -> int:
         if args.pattern != "dense":
             ap.error("--gen is a dense Generations config")
         n = args.size if args.size is not None else 4096
-        # ~2 s of device compute at the measured ~4.8e11 gen-kernel cups
+        # ~2 s of device compute at the r5 VMEM gen3 kernel's measured
+        # ~1.5e12 cups (the scan era sized for 4.8e11)
         turns = (args.turns if args.turns is not None
-                 else max(256, int(1e12) // (n * n)))
+                 else max(256, int(3e12) // (n * n)))
         return bench_generations(n, turns)
 
     if args.pattern != "dense":

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import re
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -206,18 +207,49 @@ def _packed_step3(a: jax.Array, d: jax.Array, rule: GenerationsRule):
 
 
 @functools.partial(jax.jit, static_argnames=("num_turns", "rule"))
-def packed_run_turns3(
+def _packed_run_turns3_scan(
     a: jax.Array, d: jax.Array, num_turns: int, rule: GenerationsRule
 ):
-    """Advance a bit-plane (alive, dying) pair `num_turns` turns in one
-    compiled scan. Measured 209e9 cups on a 2048² board on the real chip
-    (~80x the uint8 LUT kernel); a VMEM-resident pallas variant was tried
-    and came out SLOWER than this scan (XLA fuses the two-plane adder
-    network well), so the scan is the engine."""
+    """The two-plane XLA scan: one `_packed_step3` per turn. The
+    fallback engine for non-TPU platforms and boards beyond the VMEM
+    kernel's budget."""
     def body(planes, _):
         return _packed_step3(*planes, rule), None
     (a, d), _ = lax.scan(body, (a, d), None, length=num_turns)
     return a, d
+
+
+def packed_run_turns3(
+    a: jax.Array, d: jax.Array, num_turns: int, rule: GenerationsRule,
+    platform: Optional[str] = None,
+):
+    """Advance a bit-plane (alive, dying) pair `num_turns` turns —
+    the gen3 engine DISPATCHER. On TPU, planes that fit the VMEM
+    budget run the transposed multi-turn pallas kernel
+    (`ops/pallas_stencil.pallas_packed_run_turns3` — r5: 2.2x the scan,
+    1.52-1.59e12 vs 0.71-0.74e12 cups on 4096² Brian's Brain,
+    interleaved A/B on the real chip; the r4 note that a pallas variant
+    was slower predates its transpose + shared-sums + unroll recipe).
+    Everything else uses the XLA scan. `platform` must be supplied when
+    a/d may be tracers (callers composing this inside their own jit) —
+    a tracer has no devices to inspect."""
+    if platform is None:
+        devices = getattr(a, "devices", None)
+        dev = next(iter(devices())) if devices else jax.devices()[0]
+        platform = dev.platform
+    from gol_tpu.ops.pallas_stencil import (
+        fits_in_vmem3,
+        pallas_packed_run_turns3,
+    )
+
+    # wp == 1 would lower to zero-size vector slices in Mosaic, same
+    # guard as the life-like dispatch (`parallel/halo.packed_run_kind`).
+    if (platform == "tpu" and a.shape[-1] >= 2
+            and fits_in_vmem3(a.shape)):
+        out = pallas_packed_run_turns3(
+            jnp.stack([a, d]), num_turns, rule)
+        return out[0], out[1]
+    return _packed_run_turns3_scan(a, d, num_turns, rule)
 
 
 class GenerationsTorus:

@@ -569,14 +569,15 @@ def _make_compiled_gen3_run(mesh: Mesh, rule):
 
 
 @functools.lru_cache(maxsize=64)
-def _gen3_single_run(rule):
+def _gen3_single_run(rule, platform: str):
     """Cached jit of the single-shard stacked-planes run (a fresh closure
-    per call would re-trace/compile every chunk)."""
+    per call would re-trace/compile every chunk). `platform` is static
+    (from the mesh) so the engine dispatch below composes inside jit."""
     from gol_tpu.models.generations import packed_run_turns3
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def run1(s, k):
-        a, d = packed_run_turns3(s[0], s[1], k, rule)
+        a, d = packed_run_turns3(s[0], s[1], k, rule, platform=platform)
         return jnp.stack([a, d])
 
     return run1
@@ -586,10 +587,12 @@ def sharded_gen3_run_turns(
     stacked: jax.Array, num_turns: int, mesh: Mesh, rule
 ) -> jax.Array:
     """Advance stacked packed (alive, dying) planes of a 3-state rule.
-    Single-shard meshes use the roll-based two-plane scan directly (no
-    shard_map wrapper — same fast-path policy as the life-like board)."""
+    Single-shard meshes dispatch straight to the best single-device
+    gen3 engine (VMEM pallas kernel on TPU when the planes fit, else
+    the scan — same fast-path policy as the life-like board)."""
     if mesh.shape[ROWS_AXIS] == 1:
-        return _gen3_single_run(rule)(stacked, num_turns)
+        return _gen3_single_run(
+            rule, mesh.devices.flat[0].platform)(stacked, num_turns)
     return _make_compiled_gen3_run(mesh, rule)(stacked, num_turns)
 
 

@@ -48,6 +48,88 @@ def test_pallas_interpret_lifelike_rules(rule):
     assert np.array_equal(got, want)
 
 
+@pytest.mark.parametrize("turns", [1, 8, 19])
+def test_pallas_gen3_interpret_matches_scan(turns):
+    """r5 two-plane VMEM kernel (transposed layout + shared
+    self-inclusive sums over the ALIVE plane + unroll): bit-exact with
+    the two-plane scan and the uint8 LUT kernel for Brian's Brain and a
+    survival-bearing 3-state rule."""
+    import jax.numpy as jnp
+
+    from gol_tpu.models.generations import (
+        BRIANS_BRAIN,
+        GenerationsRule,
+        _packed_run_turns3_scan,
+        run_turns as gen_run_turns,
+    )
+    from gol_tpu.ops.pallas_stencil import pallas_packed_run_turns3
+
+    for rule in (BRIANS_BRAIN, GenerationsRule("125/36/3")):
+        rng = np.random.default_rng(turns * 7 + rule.states)
+        board = rng.integers(0, 3, size=(40, 64)).astype(np.uint8)
+        a = jnp.asarray(pack((board == 1).astype(np.uint8)))
+        d = jnp.asarray(pack((board == 2).astype(np.uint8)))
+        out = pallas_packed_run_turns3(
+            jnp.stack([a, d]), turns, rule, interpret=True)
+        wa, wd = _packed_run_turns3_scan(a, d, turns, rule)
+        np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(wa))
+        np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(wd))
+        state = (np.asarray(unpack(out[0]))
+                 + 2 * np.asarray(unpack(out[1]))).astype(np.uint8)
+        want = np.asarray(gen_run_turns(jnp.asarray(board), turns, rule))
+        np.testing.assert_array_equal(state, want)
+
+
+def test_gen3_dispatcher_platform_gate(monkeypatch):
+    """The dispatcher's ROUTING is executed, not just its gate math:
+    on this CPU mesh (and for over-budget or wp==1 boards under a
+    forced platform='tpu') it must run the scan; with platform='tpu'
+    and an eligible board it must call the VMEM kernel."""
+    import jax.numpy as jnp
+
+    import gol_tpu.ops.pallas_stencil as ps
+    from gol_tpu.models.generations import (
+        BRIANS_BRAIN,
+        _packed_run_turns3_scan,
+        packed_run_turns3,
+    )
+    from gol_tpu.ops.pallas_stencil import fits_in_vmem3
+
+    assert fits_in_vmem3((128, 128))
+    assert not fits_in_vmem3((1 << 14, 1 << 9))  # 2 planes x 32 MB
+
+    calls = []
+
+    def fake_kernel(stacked, num_turns, rule, interpret=False):
+        calls.append(("vmem", stacked.shape, num_turns))
+        # stand-in result with the right shape: the scan's own output
+        a, d = _packed_run_turns3_scan(
+            stacked[0], stacked[1], num_turns, rule)
+        return jnp.stack([a, d])
+
+    monkeypatch.setattr(ps, "pallas_packed_run_turns3", fake_kernel)
+    rng = np.random.default_rng(3)
+    board = rng.integers(0, 3, size=(16, 64)).astype(np.uint8)
+    a = jnp.asarray(pack((board == 1).astype(np.uint8)))
+    d = jnp.asarray(pack((board == 2).astype(np.uint8)))
+
+    # CPU platform (inferred from the arrays): scan path, no kernel call.
+    wa, wd = packed_run_turns3(a, d, 4, BRIANS_BRAIN)
+    assert calls == []
+    # Forced TPU platform + eligible board: the kernel is chosen.
+    ka, kd = packed_run_turns3(a, d, 4, BRIANS_BRAIN, platform="tpu")
+    assert calls == [("vmem", (2, 16, 2), 4)]
+    np.testing.assert_array_equal(np.asarray(ka), np.asarray(wa))
+    np.testing.assert_array_equal(np.asarray(kd), np.asarray(wd))
+    # Forced TPU but wp == 1 (Mosaic zero-size hazard): scan again.
+    calls.clear()
+    b1 = rng.integers(0, 3, size=(16, 32)).astype(np.uint8)
+    a1 = jnp.asarray(pack((b1 == 1).astype(np.uint8)))
+    d1 = jnp.asarray(pack((b1 == 2).astype(np.uint8)))
+    packed_run_turns3(a1, d1, 4, BRIANS_BRAIN, platform="tpu")
+    assert calls == []
+
+
 def test_fits_in_vmem_gate():
     assert fits_in_vmem((512, 16))
     assert fits_in_vmem((5120, 160))
