@@ -415,45 +415,65 @@ def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
     return 0 if parity is not False else 1
 
 
-def bench_generations(n: int, turns: int) -> int:
-    """Opt-in leg (`--gen`): Brian's Brain on the bit-plane packed
-    kernel — the Generations family's throughput number, gated on exact
-    board parity vs the independent uint8 LUT kernel."""
-    import jax
+def bench_generations(n: int, turns: int,
+                      rulestring: str = "/2/3") -> int:
+    """Opt-in leg (`--gen [--gen-rule R]`): a 3- or 4-state rule on its
+    bit-plane packed kernel (Brian's Brain default; `--gen-rule
+    345/2/4` = Star Wars) — the Generations family's throughput number,
+    gated on exact board parity vs the independent uint8 LUT kernel."""
     import jax.numpy as jnp
 
     from gol_tpu.models.generations import (
-        BRIANS_BRAIN,
+        GenerationsRule,
+        pack_state4,
         packed_run_turns3,
+        packed_run_turns4,
         run_turns,
+        unpack_state4,
     )
     from gol_tpu.ops.bitpack import pack, unpack
     from gol_tpu.utils.sync import wait
 
-    rule = BRIANS_BRAIN
+    rule = GenerationsRule(rulestring)
+    if rule.states not in (3, 4):
+        print(f"BENCH LEG SKIPPED (gen): no packed kernel for "
+              f"{rule.states} states", file=sys.stderr)
+        return 0
     rng = np.random.default_rng(0)
-    board = rng.integers(0, 3, size=(n, n)).astype(np.uint8)
-    a = jnp.asarray(pack((board == 1).astype(np.uint8)))
-    d = jnp.asarray(pack((board == 2).astype(np.uint8)))
+    board = rng.integers(0, rule.states, size=(n, n)).astype(np.uint8)
+    if rule.states == 3:
+        p0 = jnp.asarray(pack((board == 1).astype(np.uint8)))
+        p1 = jnp.asarray(pack((board == 2).astype(np.uint8)))
+        run = packed_run_turns3
+
+        def to_state(x0, x1):
+            return (np.asarray(unpack(x0))
+                    + 2 * np.asarray(unpack(x1))).astype(np.uint8)
+    else:
+        p0, p1 = (jnp.asarray(p) for p in pack_state4(board))
+        run = packed_run_turns4
+        to_state = unpack_state4
 
     # parity gate: 64 turns, full board vs the uint8 LUT kernel
-    pa, pd = packed_run_turns3(a, d, 64, rule)
-    got = (np.asarray(unpack(pa)) + 2 * np.asarray(unpack(pd))
-           ).astype(np.uint8)
+    got = to_state(*run(p0, p1, 64, rule))
     want = np.asarray(run_turns(jnp.asarray(board), 64, rule))
     parity = bool(np.array_equal(got, want))
     if not parity:
-        print(f"PARITY FAIL (generations {n}x{n})", file=sys.stderr)
+        print(f"PARITY FAIL (generations {rule.rulestring} {n}x{n})",
+              file=sys.stderr)
 
-    wait(packed_run_turns3(a, d, turns, rule)[0])  # compile warmup
+    wait(run(p0, p1, turns, rule)[0])  # compile warmup
     t0 = time.perf_counter()
-    oa, od = packed_run_turns3(a, d, turns, rule)
-    wait(oa)
-    wait(od)
+    o0, o1 = run(p0, p1, turns, rule)
+    wait(o0)
+    wait(o1)
     elapsed = time.perf_counter() - t0
     cups = turns * n * n / elapsed
+    name = {"/2/3": "Brian's Brain /2/3",
+            "345/2/4": "Star Wars 345/2/4"}.get(
+        rule.rulestring, rule.rulestring)
     _emit(
-        f"cell-updates/sec (Brian's Brain /2/3, {n}x{n} torus)",
+        f"cell-updates/sec ({name}, {n}x{n} torus)",
         round(cups, 1), "cell-updates/s", None,
         {"size": n, "turns": turns, "elapsed_s": round(elapsed, 4),
          "turns_per_s": round(turns / elapsed, 1),
@@ -604,6 +624,9 @@ def main() -> int:
     ap.add_argument("--gen", action="store_true",
                     help="run the Generations-family leg (Brian's Brain "
                          "bit-plane kernel; combine with --size/--turns)")
+    ap.add_argument("--gen-rule", default="/2/3", metavar="RULE",
+                    help="rule for the --gen leg: any 3- or 4-state "
+                         "rulestring (default /2/3; 345/2/4 = Star Wars)")
     ap.add_argument("--ksweep", action="store_true",
                     help="two-point K-sweep for --size: marginal "
                          "per-turn cost + asymptotic cups + roofline")
@@ -633,11 +656,11 @@ def main() -> int:
         if args.pattern != "dense":
             ap.error("--gen is a dense Generations config")
         n = args.size if args.size is not None else 4096
-        # ~2 s of device compute at the r5 VMEM gen3 kernel's measured
+        # ~2 s of device compute at the r5 VMEM gen kernels' measured
         # ~1.5e12 cups (the scan era sized for 4.8e11)
         turns = (args.turns if args.turns is not None
                  else max(256, int(3e12) // (n * n)))
-        return bench_generations(n, turns)
+        return bench_generations(n, turns, args.gen_rule)
 
     if args.pattern != "dense":
         if args.size is not None:

@@ -194,16 +194,30 @@ def run_turns(
 #
 # 32 cells per uint32 lane instead of one per byte — the same bit-
 # parallel win as the life-like packed kernel.
+#
+# r5 adds the C=4 sibling (Star Wars etc.): states 0..3 binary-encoded
+# in two planes (b0 = state bit 0, b1 = state bit 1; alive = b0 & ~b1),
+# with the dying chain 2 -> 3 -> 0 as pure bit logic:
+#
+#     b0' = (dead & born(n)) | (alive & survive(n)) | dying1
+#     b1' = (alive & ~survive(n)) | dying1        (dying1 = ~b0 & b1)
+#
+# Both packed families share the count network and ride the same
+# transposed VMEM pallas kernels on TPU (`ops/pallas_stencil`).
 
 
 def _packed_step3(a: jax.Array, d: jax.Array, rule: GenerationsRule):
-    from gol_tpu.ops.bitpack import neighbour_count_bits, rule_masks
+    from gol_tpu.ops.bitpack import (
+        gen3_transition,
+        neighbour_count_bits,
+        rule_masks,
+    )
 
     above = jnp.roll(a, 1, axis=-2)
     below = jnp.roll(a, -1, axis=-2)
     n0, n1, n2, n3 = neighbour_count_bits(above, a, below)
     born, surv = rule_masks(n0, n1, n2, n3, rule.born, rule.survive)
-    return (~a & ~d & born) | (a & surv), a & ~surv
+    return gen3_transition(a, d, born, surv)
 
 
 @functools.partial(jax.jit, static_argnames=("num_turns", "rule"))
@@ -219,44 +233,108 @@ def _packed_run_turns3_scan(
     return a, d
 
 
+def _dispatch_two_planes(p0, p1, num_turns, rule, platform,
+                         scan_fn, kernel_fn):
+    """The ONE two-plane engine-dispatch policy (gen3 and gen4 share
+    it): the transposed VMEM pallas kernel on TPU when both planes fit
+    the budget — wp == 1 excluded, it would lower to zero-size vector
+    slices in Mosaic, the same guard as the life-like dispatch
+    (`parallel/halo.packed_run_kind`) — else the XLA scan. `platform`
+    must be supplied when the planes may be tracers (callers composing
+    this inside their own jit) — a tracer has no devices to inspect."""
+    if platform is None:
+        devices = getattr(p0, "devices", None)
+        dev = next(iter(devices())) if devices else jax.devices()[0]
+        platform = dev.platform
+    from gol_tpu.ops.pallas_stencil import fits_in_vmem3
+
+    if (platform == "tpu" and p0.shape[-1] >= 2
+            and fits_in_vmem3(p0.shape)):
+        out = kernel_fn(jnp.stack([p0, p1]), num_turns, rule)
+        return out[0], out[1]
+    return scan_fn(p0, p1, num_turns, rule)
+
+
 def packed_run_turns3(
     a: jax.Array, d: jax.Array, num_turns: int, rule: GenerationsRule,
     platform: Optional[str] = None,
 ):
-    """Advance a bit-plane (alive, dying) pair `num_turns` turns —
-    the gen3 engine DISPATCHER. On TPU, planes that fit the VMEM
-    budget run the transposed multi-turn pallas kernel
-    (`ops/pallas_stencil.pallas_packed_run_turns3` — r5: 2.2x the scan,
-    1.52-1.59e12 vs 0.71-0.74e12 cups on 4096² Brian's Brain,
-    interleaved A/B on the real chip; the r4 note that a pallas variant
-    was slower predates its transpose + shared-sums + unroll recipe).
-    Everything else uses the XLA scan. `platform` must be supplied when
-    a/d may be tracers (callers composing this inside their own jit) —
-    a tracer has no devices to inspect."""
-    if platform is None:
-        devices = getattr(a, "devices", None)
-        dev = next(iter(devices())) if devices else jax.devices()[0]
-        platform = dev.platform
-    from gol_tpu.ops.pallas_stencil import (
-        fits_in_vmem3,
-        pallas_packed_run_turns3,
+    """Advance a bit-plane (alive, dying) pair `num_turns` turns — the
+    gen3 engine dispatcher (policy: `_dispatch_two_planes`). The VMEM
+    kernel is 2.2x the scan (r5: 1.52-1.59e12 vs 0.71-0.74e12 cups on
+    4096² Brian's Brain, interleaved A/B on the real chip; the r4 note
+    that a pallas variant was slower predates its transpose +
+    shared-sums + unroll recipe)."""
+    from gol_tpu.ops.pallas_stencil import pallas_packed_run_turns3
+
+    return _dispatch_two_planes(
+        a, d, num_turns, rule, platform,
+        _packed_run_turns3_scan, pallas_packed_run_turns3)
+
+
+def _packed_step4(b0: jax.Array, b1: jax.Array, rule: GenerationsRule):
+    """One torus turn of binary-encoded 4-state planes (module note)."""
+    from gol_tpu.ops.bitpack import (
+        gen4_transition,
+        neighbour_count_bits,
+        rule_masks,
     )
 
-    # wp == 1 would lower to zero-size vector slices in Mosaic, same
-    # guard as the life-like dispatch (`parallel/halo.packed_run_kind`).
-    if (platform == "tpu" and a.shape[-1] >= 2
-            and fits_in_vmem3(a.shape)):
-        out = pallas_packed_run_turns3(
-            jnp.stack([a, d]), num_turns, rule)
-        return out[0], out[1]
-    return _packed_run_turns3_scan(a, d, num_turns, rule)
+    a = b0 & ~b1
+    above = jnp.roll(a, 1, axis=-2)
+    below = jnp.roll(a, -1, axis=-2)
+    n0, n1, n2, n3 = neighbour_count_bits(above, a, below)
+    born, surv = rule_masks(n0, n1, n2, n3, rule.born, rule.survive)
+    return gen4_transition(b0, b1, born, surv)
+
+
+@functools.partial(jax.jit, static_argnames=("num_turns", "rule"))
+def _packed_run_turns4_scan(
+    b0: jax.Array, b1: jax.Array, num_turns: int, rule: GenerationsRule
+):
+    def body(planes, _):
+        return _packed_step4(*planes, rule), None
+    (b0, b1), _ = lax.scan(body, (b0, b1), None, length=num_turns)
+    return b0, b1
+
+
+def packed_run_turns4(
+    b0: jax.Array, b1: jax.Array, num_turns: int, rule: GenerationsRule,
+    platform: Optional[str] = None,
+):
+    """Advance binary-encoded 4-state planes `num_turns` turns — the
+    C=4 engine dispatcher (policy: `_dispatch_two_planes`; r5: 2.6x
+    the scan on 4096² Star Wars, 1.61-1.69e12 vs 0.62e12 cups)."""
+    from gol_tpu.ops.pallas_stencil import pallas_packed_run_turns4
+
+    return _dispatch_two_planes(
+        b0, b1, num_turns, rule, platform,
+        _packed_run_turns4_scan, pallas_packed_run_turns4)
+
+
+def pack_state4(state: np.ndarray):
+    """uint8 4-state board -> (b0, b1) packed binary planes."""
+    from gol_tpu.ops.bitpack import pack
+
+    s = np.asarray(state, dtype=np.uint8)
+    return (pack((s & 1).astype(np.uint8)),
+            pack(((s >> 1) & 1).astype(np.uint8)))
+
+
+def unpack_state4(b0, b1) -> np.ndarray:
+    """(b0, b1) packed planes -> uint8 4-state board."""
+    from gol_tpu.ops.bitpack import unpack
+
+    return (np.asarray(unpack(b0))
+            + 2 * np.asarray(unpack(b1))).astype(np.uint8)
 
 
 class GenerationsTorus:
     """A multi-state board on a torus; same macro-run surface as the
-    dense engines (`run`, `alive_count`, `board`). Three-state rules on
-    32-aligned widths run bit-packed (two planes, 32 cells/lane); other
-    configurations use the uint8 LUT kernel."""
+    dense engines (`run`, `alive_count`, `board`). Three- and
+    four-state rules on 32-aligned widths run bit-packed (two planes,
+    32 cells/lane — alive/dying planes for C=3, binary encoding for
+    C=4); other configurations use the uint8 LUT kernel."""
 
     def __init__(self, board: np.ndarray,
                  rule: GenerationsRule = BRIANS_BRAIN) -> None:
@@ -268,13 +346,19 @@ class GenerationsTorus:
                 f"board has states >= {rule.states} ({rule.rulestring})")
         self.rule = rule
         self.turn = 0
-        self._packed = (rule.states == 3
-                        and board.shape[1] % 32 == 0)
+        aligned = board.shape[1] % 32 == 0
+        self._packed = rule.states == 3 and aligned
+        self._packed4 = rule.states == 4 and aligned
         if self._packed:
             from gol_tpu.ops.bitpack import pack
 
             self._a = jax.device_put(pack((board == 1).astype(np.uint8)))
             self._d = jax.device_put(pack((board == 2).astype(np.uint8)))
+            self._state = None
+        elif self._packed4:
+            b0, b1 = pack_state4(board)
+            self._b0 = jax.device_put(b0)
+            self._b1 = jax.device_put(b1)
             self._state = None
         else:
             self._state = jax.device_put(board)
@@ -283,6 +367,9 @@ class GenerationsTorus:
         if self._packed:
             self._a, self._d = packed_run_turns3(
                 self._a, self._d, turns, self.rule)
+        elif self._packed4:
+            self._b0, self._b1 = packed_run_turns4(
+                self._b0, self._b1, turns, self.rule)
         else:
             self._state = run_turns(self._state, turns, self.rule)
         self.turn += turns
@@ -295,6 +382,8 @@ class GenerationsTorus:
             a = np.asarray(unpack(self._a))
             d = np.asarray(unpack(self._d))
             return (a + 2 * d).astype(np.uint8)
+        if self._packed4:
+            return unpack_state4(self._b0, self._b1)
         return np.asarray(jax.device_get(self._state))
 
     def alive_count(self) -> int:
@@ -303,4 +392,8 @@ class GenerationsTorus:
             from gol_tpu.ops.bitpack import packed_alive_count
 
             return packed_alive_count(self._a)
+        if self._packed4:
+            from gol_tpu.ops.bitpack import packed_alive_count
+
+            return packed_alive_count(self._b0 & ~self._b1)
         return state_alive_count(self._state)

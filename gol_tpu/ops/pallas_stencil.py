@@ -118,13 +118,13 @@ def _step_transposed3(a: jax.Array, d: jax.Array, rule):
     masks take count_offset=1 — for a dead cell n9 == n8 so the born
     LUT needs no shift, for an alive cell n9 == n8 + 1 so survive
     shifts by one, exactly the life-like translation."""
-    from gol_tpu.ops.bitpack import rule_masks
+    from gol_tpu.ops.bitpack import gen3_transition, rule_masks
 
     n0, n1, n2, n3 = _self_inclusive_count_bits(
         a, word_axis=0, row_axis=1)
     born, surv = rule_masks(n0, n1, n2, n3, rule.born, rule.survive,
                             count_offset=1)
-    return (~a & ~d & born) | (a & surv), a & ~surv
+    return gen3_transition(a, d, born, surv)
 
 
 def _step_rows_cols(p: jax.Array, rule: LifeLikeRule) -> jax.Array:
@@ -158,9 +158,26 @@ def _make_kernel(num_turns: int, rule: LifeLikeRule):
     return kernel
 
 
-def _make_kernel3(num_turns: int, rule):
-    """Two-plane (gen3) variant of `_make_kernel`: stacked (2, H, Wp)
-    planes in VMEM, transposed compute layout, same unroll."""
+def _step_transposed4(b0: jax.Array, b1: jax.Array, rule):
+    """One 4-state turn on transposed (Wp, H) binary-encoded planes
+    (encoding + transition algebra: `models/generations.py` module
+    note). Same self-inclusive count translation as the C=3 kernel —
+    born unshifted (dead cells have n9 == n8), survive shifted by 1."""
+    from gol_tpu.ops.bitpack import gen4_transition, rule_masks
+
+    a = b0 & ~b1
+    n0, n1, n2, n3 = _self_inclusive_count_bits(
+        a, word_axis=0, row_axis=1)
+    born, surv = rule_masks(n0, n1, n2, n3, rule.born, rule.survive,
+                            count_offset=1)
+    return gen4_transition(b0, b1, born, surv)
+
+
+def _make_kernel2p(num_turns: int, rule, step):
+    """Two-plane variant of `_make_kernel`: stacked (2, H, Wp) planes
+    in VMEM, transposed compute layout, same unroll. `step` is the
+    per-turn transposed two-plane function (gen3's alive/dying planes
+    or gen4's binary-encoded planes)."""
     main, rem = divmod(num_turns, VMEM_KERNEL_UNROLL)
 
     def kernel(in_ref, out_ref):
@@ -169,11 +186,11 @@ def _make_kernel3(num_turns: int, rule):
             def body(_, planes):
                 a, d = planes
                 for _ in range(VMEM_KERNEL_UNROLL):
-                    a, d = _step_transposed3(a, d, rule)
+                    a, d = step(a, d, rule)
                 return a, d
             a, d = lax.fori_loop(0, main, body, (a, d))
         for _ in range(rem):
-            a, d = _step_transposed3(a, d, rule)
+            a, d = step(a, d, rule)
         out_ref[0] = a.T
         out_ref[1] = d.T
     return kernel
@@ -221,7 +238,27 @@ def pallas_packed_run_turns3(
     if num_turns == 0:
         return stacked
     return _vmem_pallas_call(
-        _make_kernel3(num_turns, rule), stacked, interpret)
+        _make_kernel2p(num_turns, rule, _step_transposed3),
+        stacked, interpret)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_turns", "rule", "interpret")
+)
+def pallas_packed_run_turns4(
+    stacked: jax.Array,
+    num_turns: int,
+    rule,
+    interpret: bool = False,
+) -> jax.Array:
+    """Advance stacked binary-encoded 4-state planes (b0, b1)
+    `num_turns` turns in one VMEM-resident kernel — the C=4 sibling of
+    `pallas_packed_run_turns3` (r5; Star Wars at bit-parallel rates)."""
+    if num_turns == 0:
+        return stacked
+    return _vmem_pallas_call(
+        _make_kernel2p(num_turns, rule, _step_transposed4),
+        stacked, interpret)
 
 
 # ------------------------------------------------------------------ banded

@@ -531,7 +531,11 @@ def _gen3_local_step(stacked: jax.Array, n_shards: int, rule):
     the ALIVE plane's edge rows (the dying plane transitions locally),
     then the carry-save adder network + two-plane rule from
     `models/generations.packed_run_turns3`."""
-    from gol_tpu.ops.bitpack import neighbour_count_bits, rule_masks
+    from gol_tpu.ops.bitpack import (
+        gen3_transition,
+        neighbour_count_bits,
+        rule_masks,
+    )
 
     a, d = stacked[0], stacked[1]
     top, bot = _exchange_row_halos(a, n_shards)
@@ -539,8 +543,7 @@ def _gen3_local_step(stacked: jax.Array, n_shards: int, rule):
     n0, n1, n2, n3 = neighbour_count_bits(
         padded[:-2, :], a, padded[2:, :])
     born, surv = rule_masks(n0, n1, n2, n3, rule.born, rule.survive)
-    a2 = (~a & ~d & born) | (a & surv)
-    d2 = a & ~surv
+    a2, d2 = gen3_transition(a, d, born, surv)
     return jnp.stack([a2, d2])
 
 

@@ -85,6 +85,27 @@ def test_packed_c3_matches_unpacked_kernel():
     np.testing.assert_array_equal(gt.board, small)
 
 
+def test_packed_c4_matches_unpacked_kernel():
+    """r5: 32-aligned width + 4 states → the binary-encoded two-plane
+    path (Star Wars at bit-parallel rates); cell-identical to the uint8
+    LUT kernel and the naive oracle, including the 2→3→0 dying chain."""
+    import jax.numpy as jnp
+
+    from gol_tpu.models.generations import run_turns
+
+    rng = np.random.default_rng(43)
+    board = rng.integers(0, 4, size=(64, 64)).astype(np.uint8)
+    gt = GenerationsTorus(board, STAR_WARS)
+    assert gt._packed4 and not gt._packed
+    gt.run(30)
+    want = np.asarray(run_turns(jnp.asarray(board), 30, STAR_WARS))
+    np.testing.assert_array_equal(gt.board, want)
+    assert gt.alive_count() == int((want == 1).sum())
+    small = naive_generations(board, 30, STAR_WARS.survive,
+                              STAR_WARS.born, 4)
+    np.testing.assert_array_equal(gt.board, small)
+
+
 def test_unaligned_width_uses_unpacked_path():
     board = np.zeros((8, 24), dtype=np.uint8)
     board[4, 4] = 1

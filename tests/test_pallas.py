@@ -80,6 +80,37 @@ def test_pallas_gen3_interpret_matches_scan(turns):
         np.testing.assert_array_equal(state, want)
 
 
+@pytest.mark.parametrize("turns", [1, 8, 19])
+def test_pallas_gen4_interpret_matches_scan(turns):
+    """r5 C=4 VMEM kernel (binary-encoded planes): bit-exact with the
+    two-plane scan and the uint8 LUT kernel for Star Wars and a
+    birth-heavy 4-state rule."""
+    import jax.numpy as jnp
+
+    from gol_tpu.models.generations import (
+        STAR_WARS,
+        GenerationsRule,
+        _packed_run_turns4_scan,
+        pack_state4,
+        run_turns as gen_run_turns,
+        unpack_state4,
+    )
+    from gol_tpu.ops.pallas_stencil import pallas_packed_run_turns4
+
+    for rule in (STAR_WARS, GenerationsRule("/234/4")):
+        rng = np.random.default_rng(turns * 11 + rule.states)
+        board = rng.integers(0, 4, size=(40, 64)).astype(np.uint8)
+        b0, b1 = (jnp.asarray(p) for p in pack_state4(board))
+        out = pallas_packed_run_turns4(
+            jnp.stack([b0, b1]), turns, rule, interpret=True)
+        w0, w1 = _packed_run_turns4_scan(b0, b1, turns, rule)
+        np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(w0))
+        np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(w1))
+        state = unpack_state4(out[0], out[1])
+        want = np.asarray(gen_run_turns(jnp.asarray(board), turns, rule))
+        np.testing.assert_array_equal(state, want)
+
+
 def test_gen3_dispatcher_platform_gate(monkeypatch):
     """The dispatcher's ROUTING is executed, not just its gate math:
     on this CPU mesh (and for over-budget or wp==1 boards under a
