@@ -4,8 +4,13 @@ stream. Run:  python examples/basic_run.py [rulestring]
 The same five lines drive a remote engine instead when SER=host:port is
 set (start one with `gol-tpu-server`)."""
 
-import queue
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from a bare clone
+
+import queue
 
 from gol_tpu import Params, events as ev, run
 from gol_tpu.models.lifelike import LifeLikeRule

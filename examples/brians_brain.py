@@ -8,7 +8,12 @@ FULL interactive stack (ticker, pause, snapshot, detach, checkpoints):
     gol-tpu-server --rule /2/3     # remote engine, same contract
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from a bare clone
+
 import time
 
 import numpy as np

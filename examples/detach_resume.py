@@ -6,6 +6,11 @@ with CONT=yes and finishes the job. Run:
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from a bare clone
+
 import queue
 import threading
 import time

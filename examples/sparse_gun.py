@@ -11,7 +11,12 @@ checkpoints):
     gol-tpu-server --sparse 1048576   # remote sparse engine
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from a bare clone
+
 import time
 
 from gol_tpu.models.patterns import pattern_cells
