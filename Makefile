@@ -1,12 +1,18 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench native fixtures clean
+.PHONY: test bench obs-smoke native fixtures clean
 
 test:
 	python -m pytest tests/ -q
 
 bench:
 	python bench.py
+
+# End-to-end observability check, CPU-only: tiny board with
+# --run-report + --metrics-port 0, validates the run report schema and
+# the Prometheus /metrics output (tools/obs_smoke.py).
+obs-smoke:
+	JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
 native:
 	$(MAKE) -C csrc

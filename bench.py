@@ -184,6 +184,12 @@ def _roofline_detail(cups: float, measure_peak: bool = False) -> dict:
     return out
 
 
+# --self-report reporter: when set, every _emit line is mirrored as a
+# gol-run-report/1 `bench_leg` record, so bench artifacts live in the
+# same schema family as engine run reports (gol_tpu/obs/timeline.py).
+_SELF_REPORTER = None
+
+
 def _emit(metric, value, unit, vs_baseline, detail):
     print(json.dumps({
         "metric": metric,
@@ -192,6 +198,10 @@ def _emit(metric, value, unit, vs_baseline, detail):
         "vs_baseline": vs_baseline,
         "detail": detail,
     }))
+    if _SELF_REPORTER is not None:
+        _SELF_REPORTER.emit(
+            "bench_leg", value=value, metric=metric, unit=unit,
+            vs_baseline=vs_baseline, detail=detail, source="bench")
 
 
 def _host_step_turns(cells01: np.ndarray, turns: int) -> np.ndarray:
@@ -630,7 +640,16 @@ def main() -> int:
     ap.add_argument("--ksweep", action="store_true",
                     help="two-point K-sweep for --size: marginal "
                          "per-turn cost + asymptotic cups + roofline")
+    ap.add_argument("--self-report", metavar="PATH", default="",
+                    help="also append every BENCH line as a "
+                         "gol-run-report/1 bench_leg record to PATH "
+                         "(same schema family as --run-report)")
     args = ap.parse_args()
+    if args.self_report:
+        from gol_tpu.obs.timeline import RunReporter
+
+        global _SELF_REPORTER
+        _SELF_REPORTER = RunReporter(args.self_report)
     # Same entry-point cache policy as the CLI/server: the bench compiles
     # ~a dozen distinct programs per matrix run (timed lengths, warmups,
     # parity replays, the sparse ladder); the persistent cache turns

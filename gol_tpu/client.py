@@ -21,12 +21,14 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 import uuid
 from typing import Sequence, Tuple
 
 import numpy as np
 
 from gol_tpu.engine import EngineBusy, EngineKilled
+from gol_tpu.obs import catalog as obs
 from gol_tpu.params import Params
 from gol_tpu.utils.envcfg import env_float, env_int
 from gol_tpu.wire import recv_msg, send_msg
@@ -70,13 +72,24 @@ class RemoteEngine:
         self._token = uuid.uuid4().hex
 
     def _call(self, header: dict, world=None, timeout=None):
-        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        label = obs.method_label(str(header.get("method")))
+        obs.CLIENT_REQUESTS.labels(method=label).inc()
+        t0 = time.monotonic()
         try:
-            sock.settimeout(timeout)  # None → block (long-running run call)
-            send_msg(sock, header, world)
-            resp, resp_world = recv_msg(sock)
+            sock = socket.create_connection(
+                self._addr, timeout=self._timeout)
+            try:
+                sock.settimeout(timeout)  # None → block (long run call)
+                send_msg(sock, header, world)
+                resp, resp_world = recv_msg(sock)
+            finally:
+                sock.close()
+        except (ConnectionError, OSError):
+            obs.CLIENT_ERRORS.labels(method=label).inc()
+            raise
         finally:
-            sock.close()
+            obs.CLIENT_REQUEST_SECONDS.labels(method=label).observe(
+                time.monotonic() - t0)
         _check_resp(resp)
         return resp, resp_world
 
@@ -136,6 +149,8 @@ class RemoteEngine:
                         sock.close()
                         return
 
+        obs.CLIENT_REQUESTS.labels(method="ServerDistributor").inc()
+        t0 = time.monotonic()
         try:
             sock.settimeout(None)  # block for the whole run
             # Watchdog up BEFORE the upload: a partition mid-send of a
@@ -146,6 +161,7 @@ class RemoteEngine:
             send_msg(sock, header, world)
             resp, out = recv_msg(sock)
         except (ConnectionError, OSError) as e:
+            obs.CLIENT_ERRORS.labels(method="ServerDistributor").inc()
             if lost.is_set():
                 raise ConnectionError(
                     f"engine heartbeat lost ({hb_misses} misses x "
@@ -153,6 +169,8 @@ class RemoteEngine:
             raise
         finally:
             stop.set()
+            obs.CLIENT_REQUEST_SECONDS.labels(
+                method="ServerDistributor").observe(time.monotonic() - t0)
             try:
                 sock.close()
             except OSError:
@@ -167,6 +185,14 @@ class RemoteEngine:
     def stats(self) -> dict:
         resp, _ = self._call({"method": "Stats"}, timeout=self._timeout)
         return dict(resp["stats"])
+
+    def get_metrics(self) -> dict:
+        """The server's full metrics-registry snapshot
+        (`Registry.snapshot()` shape — engine gauges, wire byte
+        counters, per-method request counts/latency)."""
+        resp, _ = self._call({"method": "GetMetrics"},
+                             timeout=self._timeout)
+        return dict(resp["metrics"])
 
     def abort_run(self) -> bool:
         """Stop the engine's current run IF it is this controller's own

@@ -48,6 +48,8 @@ import numpy as np
 
 from gol_tpu.models.generations import GenerationsRule
 from gol_tpu.models.lifelike import CONWAY
+from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import timeline as obs_timeline
 from gol_tpu.ops.bitpack import pack, packed_alive_count, unpack
 from gol_tpu.ops.stencil import alive_count_exact, from_pixels, to_pixels
 from gol_tpu.params import Params
@@ -106,6 +108,11 @@ PIPELINE_BOARD_BUDGET = 8 << 30
 # per run — the counterpart of the reference's runtime/trace TestTrace
 # artifact (`Local/trace_test.go:19-27`, SURVEY §5).
 TRACE_ENV = "GOL_TRACE"
+
+# GOL_RUN_REPORT=<path>: append a JSON-lines chunk-timeline run report
+# (`gol_tpu/obs/timeline.py`, schema gol-run-report/1) — one record per
+# retired chunk plus run_start/run_end bookends. Set by `--run-report`.
+RUN_REPORT_ENV = obs_timeline.RUN_REPORT_ENV
 
 # GOL_CKPT=<dir> [GOL_CKPT_EVERY=<seconds>]: periodic crash-safe
 # checkpoints during a run. The reference has only in-memory state plus
@@ -495,6 +502,12 @@ class Engine(ControlFlagProtocol):
         # the reference's mutex-coherent pair (`Server:131-134`) and the
         # sparse engine's publication discipline.
         self._alive_pub: Optional[Tuple[int, int]] = None
+        # Monotonic floor on the published turn within a run: new runs
+        # and checkpoint restores legitimately rewind it (reset_floor);
+        # anything else moving it backwards is a publication-ordering
+        # bug, counted rather than asserted so production keeps running
+        # (gol_engine_published_turn_regressions_total).
+        self._pub_floor = -1
         self._flags: "queue.Queue[int]" = queue.Queue()
         self._killed = False
         self._running = False
@@ -518,6 +531,25 @@ class Engine(ControlFlagProtocol):
         # later runs of the same configuration start there, skipping the
         # synchronous ramp's round trips.
         self._chunk_hints: dict = {}
+
+    def _publish_locked(self, alive: int, turn: int,
+                        reset_floor: bool = False) -> None:
+        """Publish a coherent (alive, turn) pair. Caller holds
+        `_state_lock`. Every publication site routes through here so the
+        published-turn gauge and its monotonicity accounting can't drift
+        from `_alive_pub`: within a run the gauge only moves forward
+        (an out-of-order publication increments the regressions counter
+        and leaves the gauge at the floor); a new submit or checkpoint
+        restore resets the floor (`reset_floor`)."""
+        if reset_floor:
+            self._pub_floor = -1
+        self._alive_pub = (alive, turn)
+        if turn < self._pub_floor:
+            obs.ENGINE_PUBLISHED_TURN_REGRESSIONS.inc()
+            return
+        self._pub_floor = turn
+        obs.ENGINE_PUBLISHED_TURN.set(turn)
+        obs.ENGINE_PUBLISHED_ALIVE.set(alive)
 
     # ------------------------------------------------------------------ RPC
 
@@ -662,7 +694,7 @@ class Engine(ControlFlagProtocol):
             self._turn = start_turn
             # Turn-0 publication, computed host-side above: the ticker
             # has an exact pair before the first chunk ever pops.
-            self._alive_pub = (alive0, start_turn)
+            self._publish_locked(alive0, start_turn, reset_floor=True)
             self._running = True
             self._run_token = token
             self._abort.clear()
@@ -690,6 +722,20 @@ class Engine(ControlFlagProtocol):
         while chunk * 2 <= hinted:
             chunk *= 2
         quit_run = False
+        # Chunk-timeline run report (GOL_RUN_REPORT / --run-report) and
+        # the engine metric gauges: both update only at chunk and flag
+        # boundaries on the host thread, never inside compiled code —
+        # that's the whole overhead story (docs/OBSERVABILITY.md).
+        reporter = obs_timeline.from_env()
+        run_t0 = time.monotonic()
+        board_cells = height * width
+        if reporter is not None:
+            reporter.emit(
+                "run_start", w=width, h=height,
+                model=self._rule.rulestring, repr=repr_,
+                devices=int(mesh.size), turns_requested=params.turns,
+                start_turn=start_turn)
+        obs.ENGINE_CHUNK_SIZE.set(chunk)
         trace_dir = os.environ.get(TRACE_ENV, "")
         ckpt_dir = os.environ.get(CKPT_ENV, "")
         ckpt_every = env_float(CKPT_EVERY_ENV, CKPT_EVERY_DEFAULT)
@@ -699,6 +745,10 @@ class Engine(ControlFlagProtocol):
             ckpt_path = os.path.join(ckpt_dir, f"{width}x{height}.npz")
         last_ckpt = time.monotonic()
         chunks_done = 0
+        traced_chunks = 0
+        # Flag-service seconds accrued since the last chunk record — the
+        # record attributes control-plane stall to the chunk it delayed.
+        flag_pending = 0.0
         # Per-run pipeline depth: clamp so depth + 1 board generations fit
         # the board byte budget (a 2 GB flagship board still pipelines at
         # 3; a board near device-memory capacity degrades to
@@ -756,11 +806,14 @@ class Engine(ControlFlagProtocol):
             regime-appropriate chunk adapter (floor-based for
             synchronous measurements — the ramp and depth-1 mode —
             windowed-rate once the pipeline is open)."""
-            nonlocal chunk, last_pop, ramping
-            _done_cells, done_token, done_k, done_turn = inflight.popleft()
+            nonlocal chunk, last_pop, ramping, flag_pending
+            (_done_cells, done_token, done_k, done_turn,
+             done_issue) = inflight.popleft()
+            t_wait = time.monotonic()
             done_alive = int(np.asarray(
                 jax.device_get(done_token), dtype=np.int64).sum())
             now = time.monotonic()
+            token_wait = now - t_wait
             elapsed = now - last_pop
             last_pop = now
             if ramping or depth == 1:
@@ -787,7 +840,26 @@ class Engine(ControlFlagProtocol):
                 self._last_chunk = done_k
                 if rate > 0:
                     self._turns_per_s = rate
-                self._alive_pub = (done_alive, done_turn)
+                self._publish_locked(done_alive, done_turn)
+            cups = (done_k * board_cells / elapsed) if elapsed > 0 else 0.0
+            obs.ENGINE_TURN.set(done_turn)
+            obs.ENGINE_CHUNK_SIZE.set(chunk)
+            obs.ENGINE_CHUNKS_TOTAL.inc()
+            obs.ENGINE_TURNS_TOTAL.inc(done_k)
+            obs.ENGINE_CHUNK_SECONDS.observe(elapsed)
+            if cups > 0:
+                obs.ENGINE_CUPS.set(cups)
+            if rate > 0:
+                obs.ENGINE_TURNS_PER_S.set(rate)
+            if reporter is not None:
+                reporter.emit(
+                    "chunk", turn=done_turn, turns=done_k,
+                    chunk_size=chunk, wall_s=round(elapsed, 6),
+                    cups=cups, turns_per_s=rate,
+                    token_wait_s=round(token_wait, 6),
+                    dispatch_s=round(done_issue, 6),
+                    flag_s=round(flag_pending, 6), alive=done_alive)
+            flag_pending = 0.0
         try:
             while self._turn < target and not quit_run:
                 if self._killed or self._abort.is_set():
@@ -808,6 +880,16 @@ class Engine(ControlFlagProtocol):
                         cells = run(cells, k, mesh, self._rule)
                         wait(cells)
                     trace_dir = ""
+                    traced_chunks += 1
+                    obs.ENGINE_TRACED_CHUNKS_TOTAL.inc()
+                    obs.ENGINE_TURNS_TOTAL.inc(k)
+                    if reporter is not None:
+                        # Profiler path: deliberately no wall_s/cups —
+                        # a traced chunk's timing is profiler-skewed and
+                        # stays out of the pace/CUPS aggregates, exactly
+                        # as it stays out of the chunk adapter.
+                        reporter.emit("traced_chunk",
+                                      turn=self._turn + k, turns=k)
                     _reset_pace(time.monotonic())
                 else:
                     t_issue = time.monotonic()
@@ -830,7 +912,8 @@ class Engine(ControlFlagProtocol):
                     # engine-vs-kernel gap AND its window-to-window
                     # variance.
                     token.copy_to_host_async()
-                    inflight.append((cells, token, k, self._turn + k))
+                    inflight.append(
+                        (cells, token, k, self._turn + k, issue_cost))
                     while len(inflight) >= (1 if ramping else depth):
                         _pop_oldest()
                 chunks_done += 1
@@ -847,7 +930,10 @@ class Engine(ControlFlagProtocol):
                     # with the final chunk must not park a finished run.
                     t_flags = time.monotonic()
                     quit_run = self._handle_flags()
-                    if time.monotonic() - t_flags > 0.01:
+                    flag_cost = time.monotonic() - t_flags
+                    obs.ENGINE_FLAG_SERVICE_SECONDS.observe(flag_cost)
+                    flag_pending += flag_cost
+                    if flag_cost > 0.01:
                         # A pause (or slow flag drain) stalled the host.
                         _reset_pace(time.monotonic())
         finally:
@@ -870,7 +956,7 @@ class Engine(ControlFlagProtocol):
                     alive = self._alive_dispatch(
                         self._cells, self._repr, self._pad_rows)
                     with self._state_lock:
-                        self._alive_pub = (alive, self._turn)
+                        self._publish_locked(alive, self._turn)
                 except Exception:
                     pass
             with self._state_lock:
@@ -886,6 +972,15 @@ class Engine(ControlFlagProtocol):
                 self._running = False
                 self._run_token = None
                 self._abort.clear()
+            obs.ENGINE_TURN.set(final_turn)
+            if reporter is not None:
+                reporter.emit(
+                    "run_end", turn=final_turn,
+                    turns_total=final_turn - start_turn,
+                    chunks=chunks_done - traced_chunks,
+                    traced_chunks=traced_chunks,
+                    wall_s=round(time.monotonic() - run_t0, 6))
+                reporter.close()
         # On kill_prog mid-run, still hand back the partial board — the
         # state exists and discarding completed turns helps nobody; further
         # RPCs on this engine raise EngineKilled.
@@ -1169,7 +1264,7 @@ class Engine(ControlFlagProtocol):
             self._packed = repr_ == "packed"
             self._pad_rows = 0  # checkpoints store cropped boards
             self._turn = turn
-            self._alive_pub = (alive, turn)
+            self._publish_locked(alive, turn, reset_floor=True)
         return turn
 
     # ------------------------------------------------------------- internals

@@ -35,10 +35,15 @@ def run(
                         out_dir, live_view, rule, sparse)
         except BaseException as e:
             # Record for callers that need an exit status (the CLI):
-            # the thread's traceback alone doesn't reach main()'s
-            # return code.
+            # a thread's own failure alone doesn't reach main()'s
+            # return code. Reported through the structured logger
+            # (GOL_LOG=json|text) instead of re-raising into the
+            # default threading excepthook, whose raw traceback a log
+            # pipeline can't parse.
             t.exception = e
-            raise
+            from gol_tpu.obs.log import exception as log_exception
+
+            log_exception("distributor.failed", e)
 
     t = threading.Thread(
         target=_target, daemon=True, name="gol-distributor")

@@ -37,6 +37,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="enable the live board view (polls snapshots)")
     ap.add_argument("--trace", metavar="DIR", default="",
                     help="dump one jax.profiler chunk trace to DIR")
+    ap.add_argument("--run-report", metavar="PATH", default="",
+                    help="append a JSON-lines chunk-timeline run report "
+                         "(schema gol-run-report/1) to PATH; equivalent "
+                         "to GOL_RUN_REPORT=PATH")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve Prometheus text on "
+                         "http://127.0.0.1:PORT/metrics (0 = ephemeral "
+                         "port; unset = no endpoint)")
     ap.add_argument("--rule", metavar="RULE", default="",
                     help="rulestring for the in-process engine: life-like"
                          " 'B36/S23' (HighLife) or Generations "
@@ -129,6 +138,18 @@ def main(argv=None) -> int:
         from gol_tpu.engine import TRACE_ENV
 
         os.environ[TRACE_ENV] = args.trace
+    if args.run_report:
+        # Same env-var contract as --trace: the engine reads it at run
+        # time, so remote/forked engines inherit it too.
+        from gol_tpu.obs.timeline import RUN_REPORT_ENV
+
+        os.environ[RUN_REPORT_ENV] = args.run_report
+    if args.metrics_port is not None:
+        from gol_tpu.obs.http import start_metrics_server
+        from gol_tpu.obs.log import log as obs_log
+
+        msrv = start_metrics_server(args.metrics_port)
+        obs_log("metrics.serving", url=msrv.url, port=msrv.port)
     rule = None
     if args.rule:
         from gol_tpu.models import parse_rule

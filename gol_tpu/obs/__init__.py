@@ -1,0 +1,18 @@
+"""gol_tpu.obs — runtime observability: metrics registry, chunk
+timeline run reports, structured logging, and the /metrics endpoint.
+
+Deliberately jax-free so control-plane processes can import it without
+pulling a device runtime. See docs/OBSERVABILITY.md.
+"""
+
+from gol_tpu.obs import catalog  # declare every metric family up front
+from gol_tpu.obs.log import exception, log
+from gol_tpu.obs.metrics import REGISTRY, Registry, get_registry
+from gol_tpu.obs.timeline import (RUN_REPORT_ENV, SCHEMA, RunReporter,
+                                  from_env, read_report, validate_record)
+
+__all__ = [
+    "catalog", "REGISTRY", "Registry", "get_registry",
+    "RunReporter", "from_env", "read_report", "validate_record",
+    "RUN_REPORT_ENV", "SCHEMA", "log", "exception",
+]

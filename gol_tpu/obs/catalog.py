@@ -1,0 +1,121 @@
+"""The metric catalogue: every family the framework emits, declared in
+one place against the shared default registry.
+
+Importing `gol_tpu.obs` (or any instrumented module) pulls this in, so
+`/metrics` and `GetMetrics` always expose the full set of families with
+stable names even before the first engine chunk or RPC — zero-valued
+children are pre-seeded for the known wire methods for the same reason.
+docs/OBSERVABILITY.md is the human-readable mirror of this file.
+"""
+
+from __future__ import annotations
+
+from gol_tpu.obs.metrics import REGISTRY
+
+# Every method the wire protocol speaks, plus a catch-all so an
+# unrecognised header can't mint unbounded label values.
+WIRE_METHODS = (
+    "ServerDistributor", "Alivecount", "GetWorld", "GetView", "GetWindow",
+    "CFput", "DrainFlags", "KillProg", "Ping", "Stats", "AbortRun",
+    "GetMetrics", "unknown",
+)
+
+# ----------------------------------------------------------------- engine
+
+ENGINE_TURN = REGISTRY.gauge(
+    "gol_engine_turn",
+    "Latest simulated turn completed by the engine chunk loop.")
+ENGINE_PUBLISHED_TURN = REGISTRY.gauge(
+    "gol_engine_published_turn",
+    "Turn of the last published (alive, turn) pair; monotone within a "
+    "run — regressions increment "
+    "gol_engine_published_turn_regressions_total instead of moving this "
+    "gauge backwards.")
+ENGINE_PUBLISHED_ALIVE = REGISTRY.gauge(
+    "gol_engine_published_alive",
+    "Alive-cell count of the last published (alive, turn) pair.")
+ENGINE_CUPS = REGISTRY.gauge(
+    "gol_engine_cups",
+    "Achieved cell updates per second over the most recent untraced "
+    "chunk.")
+ENGINE_TURNS_PER_S = REGISTRY.gauge(
+    "gol_engine_turns_per_s",
+    "Achieved turns per second over the most recent untraced chunk.")
+ENGINE_CHUNK_SIZE = REGISTRY.gauge(
+    "gol_engine_chunk_size",
+    "Turns per compiled chunk currently chosen by the pace adapter.")
+
+ENGINE_CHUNKS_TOTAL = REGISTRY.counter(
+    "gol_engine_chunks_total",
+    "Untraced chunks retired by the engine loop.")
+ENGINE_TURNS_TOTAL = REGISTRY.counter(
+    "gol_engine_turns_total",
+    "Turns simulated across all retired chunks (traced included).")
+ENGINE_TRACED_CHUNKS_TOTAL = REGISTRY.counter(
+    "gol_engine_traced_chunks_total",
+    "Chunks run under the GOL_TRACE profiler path; excluded from pace, "
+    "CUPS, and chunk-latency aggregates.")
+ENGINE_PUBLISHED_TURN_REGRESSIONS = REGISTRY.counter(
+    "gol_engine_published_turn_regressions_total",
+    "Publications that would have moved the published turn backwards "
+    "within a run (should stay 0).")
+
+ENGINE_CHUNK_SECONDS = REGISTRY.histogram(
+    "gol_engine_chunk_seconds",
+    "Wall seconds per retired untraced chunk (token wait + bookkeeping).")
+ENGINE_FLAG_SERVICE_SECONDS = REGISTRY.histogram(
+    "gol_engine_flag_service_seconds",
+    "Seconds the chunk loop spent servicing control flags between "
+    "chunk issues.")
+
+# ------------------------------------------------------------ wire bytes
+
+WIRE_BYTES = REGISTRY.counter(
+    "gol_wire_bytes_total",
+    "Bytes moved over the wire protocol, by direction.",
+    label_names=("direction",))
+WIRE_MESSAGES = REGISTRY.counter(
+    "gol_wire_messages_total",
+    "Wire-protocol messages moved, by direction.",
+    label_names=("direction",))
+for _d in ("sent", "received"):
+    WIRE_BYTES.labels(direction=_d)
+    WIRE_MESSAGES.labels(direction=_d)
+
+# ---------------------------------------------------------------- server
+
+SERVER_REQUESTS = REGISTRY.counter(
+    "gol_server_requests_total",
+    "Requests dispatched by the engine server, by wire method.",
+    label_names=("method",))
+SERVER_ERRORS = REGISTRY.counter(
+    "gol_server_errors_total",
+    "Requests that raised inside the server dispatch, by wire method.",
+    label_names=("method",))
+SERVER_REQUEST_SECONDS = REGISTRY.histogram(
+    "gol_server_request_seconds",
+    "Server-side dispatch latency, by wire method.",
+    label_names=("method",))
+
+# ---------------------------------------------------------------- client
+
+CLIENT_REQUESTS = REGISTRY.counter(
+    "gol_client_requests_total",
+    "RPCs issued by RemoteEngine, by wire method.",
+    label_names=("method",))
+CLIENT_ERRORS = REGISTRY.counter(
+    "gol_client_errors_total",
+    "RPCs that failed (socket or protocol error), by wire method.",
+    label_names=("method",))
+CLIENT_REQUEST_SECONDS = REGISTRY.histogram(
+    "gol_client_request_seconds",
+    "Round-trip RPC latency seen by RemoteEngine, by wire method.",
+    label_names=("method",))
+
+for _m in WIRE_METHODS:
+    SERVER_REQUESTS.labels(method=_m)
+
+
+def method_label(method: str) -> str:
+    """Clamp arbitrary header method strings to the declared set."""
+    return method if method in WIRE_METHODS else "unknown"

@@ -1,0 +1,71 @@
+"""Optional `/metrics` endpoint: stdlib http.server, daemon thread.
+
+`start_metrics_server(port)` binds (port 0 = OS-assigned ephemeral),
+serves Prometheus text from the shared registry on GET /metrics, and
+returns the running server object — `.port` tells callers (and the
+obs-smoke harness) where an ephemeral bind landed. The thread is a
+daemon: it dies with the process and never blocks shutdown.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from gol_tpu.obs.metrics import REGISTRY
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Most recent server started in this process, so in-process harnesses
+# (tools/obs_smoke.py) can find the ephemeral port after main() returns.
+_LAST: Optional["MetricsServer"] = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.split("?", 1)[0] in ("/metrics", "/"):
+            body = REGISTRY.render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", PROM_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+
+class MetricsServer:
+    def __init__(self, port: int, host: str = "127.0.0.1") -> None:
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"gol-metrics-:{self.port}", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_metrics_server(port: int,
+                         host: str = "127.0.0.1") -> MetricsServer:
+    global _LAST
+    srv = MetricsServer(port, host=host)
+    _LAST = srv
+    return srv
+
+
+def last_server() -> Optional[MetricsServer]:
+    return _LAST

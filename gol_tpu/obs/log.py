@@ -1,0 +1,62 @@
+"""Structured logging: `GOL_LOG=json|text` (default text).
+
+Replaces the ad-hoc `traceback.print_exc()` / bare `print` diagnostics
+in gol.py / main.py with one-line events that a log pipeline can parse
+(`json`) or a human can read on a terminal (`text`). Events go to
+stderr so they never interleave with the SDL/stdout data paths or the
+server banner that tests/server_harness.py greps from stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+from typing import Optional
+
+LOG_ENV = "GOL_LOG"
+
+
+def _mode() -> str:
+    # Read per call, not at import: tests and long-lived processes may
+    # flip GOL_LOG after gol_tpu is imported.
+    mode = os.environ.get(LOG_ENV, "text").strip().lower()
+    return mode if mode in ("json", "text") else "text"
+
+
+def log(event: str, level: str = "info", stream=None, **fields) -> None:
+    """Emit one structured event. `fields` must be JSON-serializable."""
+    stream = stream if stream is not None else sys.stderr
+    if _mode() == "json":
+        rec = {"ts": round(time.time(), 3), "level": level, "event": event}
+        rec.update(fields)
+        line = json.dumps(rec, sort_keys=True, default=str)
+    else:
+        extras = " ".join(f"{k}={v}" for k, v in fields.items())
+        line = f"[gol:{level}] {event}" + (f" {extras}" if extras else "")
+    try:
+        print(line, file=stream, flush=True)
+    except (OSError, ValueError):
+        pass  # a closed/broken stderr must never sink the run
+
+
+def exception(event: str, exc: BaseException,
+              stream=None, **fields) -> None:
+    """`log` for a caught exception; carries type, message, and the
+    formatted traceback (as a field in json mode, as the familiar
+    multi-line block in text mode)."""
+    tb = "".join(traceback.format_exception(type(exc), exc,
+                                            exc.__traceback__))
+    if _mode() == "json":
+        log(event, level="error", stream=stream,
+            error=f"{type(exc).__name__}: {exc}", traceback=tb, **fields)
+    else:
+        log(event, level="error", stream=stream,
+            error=f"{type(exc).__name__}: {exc}", **fields)
+        try:
+            print(tb, file=stream if stream is not None else sys.stderr,
+                  end="", flush=True)
+        except (OSError, ValueError):
+            pass

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from gol_tpu.parallel.shmap import shard_map
 
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
 from gol_tpu.ops.bitpack import (

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from gol_tpu.parallel.shmap import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule

@@ -1,0 +1,32 @@
+"""`shard_map` across JAX versions — the ONE import site for every
+sharded kernel tier (`parallel/halo.py`, `parallel/mesh2d.py`).
+
+Newer JAX exports `jax.shard_map` at top level with the replication
+check spelled `check_vma`; older installs (≤0.4.x, e.g. the 0.4.37 in
+the CPU CI image) carry the same transform as
+`jax.experimental.shard_map.shard_map` with the flag's pre-rename
+spelling `check_rep`. Call sites use the modern spelling; this wrapper
+translates so one codebase runs on either."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # pre-export JAX: the experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f=None, **kwargs):
+    """`jax.shard_map`-compatible signature (callable first, config as
+    keywords — the `functools.partial(shard_map, mesh=..., ...)`
+    decorator pattern). Translates `check_vma` to the installed
+    version's spelling."""
+    if "check_vma" in kwargs and _CHECK_KW != "check_vma":
+        kwargs[_CHECK_KW] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda g: shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)

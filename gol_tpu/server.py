@@ -16,11 +16,15 @@ import argparse
 import os
 import socket
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
 from gol_tpu.engine import Engine, EngineBusy, EngineKilled
+from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import log as obs_log
+from gol_tpu.obs.metrics import REGISTRY
 from gol_tpu.params import Params
 from gol_tpu.utils.envcfg import env_float, env_int
 from gol_tpu.wire import recv_msg, send_msg
@@ -127,6 +131,22 @@ class EngineServer:
         self, conn: socket.socket, header: dict, world
     ) -> None:
         method = header.get("method")
+        # Request accounting brackets the whole dispatch, reply
+        # included — for ServerDistributor the latency histogram
+        # deliberately records the full blocking run (that IS the
+        # request's service time on this protocol).
+        label = obs.method_label(str(method))
+        obs.SERVER_REQUESTS.labels(method=label).inc()
+        t0 = time.monotonic()
+        try:
+            self._dispatch_inner(conn, method, label, header, world)
+        finally:
+            obs.SERVER_REQUEST_SECONDS.labels(method=label).observe(
+                time.monotonic() - t0)
+
+    def _dispatch_inner(
+        self, conn: socket.socket, method, label: str, header: dict, world
+    ) -> None:
         try:
             if method == "ServerDistributor":
                 p = Params(**header["params"])
@@ -145,6 +165,10 @@ class EngineServer:
                 send_msg(conn, {"ok": True, "turn": self.engine.ping()})
             elif method == "Stats":
                 send_msg(conn, {"ok": True, "stats": self.engine.stats()})
+            elif method == "GetMetrics":
+                # Full registry snapshot (engine, wire, server families)
+                # — the wire-native face of the /metrics endpoint.
+                send_msg(conn, {"ok": True, "metrics": REGISTRY.snapshot()})
             elif method == "Alivecount":
                 alive, turn = self.engine.alive_count()
                 send_msg(conn, {"ok": True, "alive": alive, "turn": turn})
@@ -183,10 +207,13 @@ class EngineServer:
                 send_msg(conn, {"ok": False,
                                 "error": f"unknown method {method!r}"})
         except EngineKilled as e:
+            obs.SERVER_ERRORS.labels(method=label).inc()
             send_msg(conn, {"ok": False, "error": f"killed: {e}"})
         except EngineBusy as e:
+            obs.SERVER_ERRORS.labels(method=label).inc()
             send_msg(conn, {"ok": False, "error": f"busy: {e}"})
         except Exception as e:  # surface engine errors to the client
+            obs.SERVER_ERRORS.labels(method=label).inc()
             send_msg(conn, {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
 
@@ -195,6 +222,11 @@ def main() -> None:
     ap.add_argument("--port", type=int,
                     default=int(os.environ.get("GOL_PORT", DEFAULT_PORT)))
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve Prometheus text on "
+                         "http://127.0.0.1:PORT/metrics (0 = ephemeral "
+                         "port; unset = no endpoint)")
     ap.add_argument("--resume", metavar="CKPT", default="",
                     help="restore (world, turn) from a checkpoint .npz "
                          "before serving (pairs with GOL_CKPT autosaves)")
@@ -264,13 +296,20 @@ def main() -> None:
                     os.makedirs(ckpt_dir, exist_ok=True)
                     path = os.path.join(ckpt_dir, f"{w}x{h}.npz")
                     srv.engine.save_checkpoint(path)
-                    print(f"SIGTERM: checkpointed turn {s['turn']} to "
-                          f"{path}", flush=True)
+                    obs_log.log("server.sigterm_checkpoint",
+                                turn=s["turn"], path=path)
             except Exception as e:
-                print(f"SIGTERM: checkpoint failed: {e}", flush=True)
+                obs_log.exception("server.sigterm_checkpoint_failed", e)
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _on_term)
+    if args.metrics_port is not None:
+        from gol_tpu.obs.http import start_metrics_server
+
+        msrv = start_metrics_server(args.metrics_port)
+        print(f"metrics on {msrv.url}", flush=True)
+    # This exact banner is the readiness contract: harnesses parse
+    # "serving on :<port>" from stdout to learn the bound port.
     print(f"gol_tpu engine serving on :{srv.port} "
           f"({len(np.atleast_1d(srv.engine._devices))} device(s), "
           f"rule {srv.engine._rule.rulestring})")

@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from gol_tpu.obs import catalog as obs
 from gol_tpu.utils.envcfg import env_int
 
 _LEN = struct.Struct(">I")
@@ -51,7 +52,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def send_msg(
     sock: socket.socket, header: dict, world: Optional[np.ndarray] = None
-) -> None:
+) -> int:
+    """Send one message; returns the bytes put on the wire."""
     header = dict(header)
     payload = None
     if world is not None:
@@ -66,6 +68,10 @@ def send_msg(
     sock.sendall(_LEN.pack(len(raw)) + raw)
     if payload is not None:
         sock.sendall(payload)
+    sent = 4 + len(raw) + (payload.nbytes if payload is not None else 0)
+    obs.WIRE_BYTES.labels(direction="sent").inc(sent)
+    obs.WIRE_MESSAGES.labels(direction="sent").inc()
+    return sent
 
 
 def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
@@ -99,4 +105,7 @@ def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
             if n_read == 0:
                 raise ConnectionError("peer closed mid-message")
             got += n_read
+    obs.WIRE_BYTES.labels(direction="received").inc(
+        4 + n + (world.nbytes if world is not None else 0))
+    obs.WIRE_MESSAGES.labels(direction="received").inc()
     return header, world

@@ -1,0 +1,418 @@
+"""Observability subsystem (gol_tpu/obs): registry semantics and thread
+safety, run-report schema, engine chunk-timeline integration, the
+published-turn monotonicity contract, GOL_TRACE exclusion from pace
+aggregates, the /metrics endpoint, and control-plane counters."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gol_tpu.obs import catalog
+from gol_tpu.obs.metrics import REGISTRY, Registry
+from gol_tpu.obs.timeline import (RUN_REPORT_ENV, SCHEMA, RunReporter,
+                                  read_report, validate_record)
+
+
+def board(h=32, w=32, seed=1):
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < 0.3).astype(np.uint8)) * 255
+
+
+# -------------------------------------------------------------- registry
+
+
+def test_counter_gauge_semantics():
+    r = Registry()
+    c = r.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("g", "a gauge")
+    g.set(7)
+    g.inc(3)
+    g.dec(5)
+    assert g.value == 5.0
+
+
+def test_histogram_buckets_and_window():
+    r = Registry()
+    h = r.histogram("h_seconds", "a histogram",
+                    buckets=(0.1, 1.0), window=4)
+    for v in (0.05, 0.5, 2.0, 0.5, 0.5):
+        h.observe(v)
+    snap = h._solo().snapshot()
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(3.55)
+    # cumulative: ≤0.1 → 1, ≤1.0 → 4 (2.0 only in +Inf)
+    assert snap["buckets"] == [[0.1, 1], [1.0, 4]]
+    # window keeps only the last 4 observations
+    assert snap["window"]["n"] == 4
+    assert snap["window"]["max"] == 2.0
+    assert snap["window"]["last"] == 0.5
+
+
+def test_labels_and_reregistration():
+    r = Registry()
+    fam = r.counter("req_total", "requests", label_names=("method",))
+    fam.labels(method="Ping").inc()
+    fam.labels(method="Ping").inc()
+    fam.labels(method="Stats").inc()
+    assert fam.labels(method="Ping").value == 2
+    with pytest.raises(ValueError):
+        fam.labels(verb="Ping")  # wrong label name
+    with pytest.raises(ValueError):
+        fam.inc()  # labelled family has no solo child
+    # idempotent re-registration returns the same family...
+    assert r.counter("req_total", "requests",
+                     label_names=("method",)) is fam
+    # ...but a kind or label clash is a programming error
+    with pytest.raises(ValueError):
+        r.gauge("req_total")
+    with pytest.raises(ValueError):
+        r.counter("req_total", label_names=("other",))
+
+
+def test_snapshot_is_json_and_prometheus_parses():
+    r = Registry()
+    r.gauge("g", "gauge help").set(1.5)
+    r.counter("c_total", "with\nnewline",
+              label_names=("m",)).labels(m='a"b\\c').inc()
+    r.histogram("h_s", buckets=(1.0,)).observe(0.5)
+    snap = r.snapshot()
+    json.dumps(snap)  # must be JSON-serializable
+    assert snap["g"]["values"][0]["value"] == 1.5
+    text = r.render_prometheus()
+    assert "# TYPE g gauge" in text
+    assert "g 1.5" in text.splitlines()
+    assert "# HELP c_total with\\nnewline" in text
+    # label escaping: " → \", \ → \\
+    assert 'c_total{m="a\\"b\\\\c"} 1' in text
+    assert 'h_s_bucket{le="1"} 1' in text
+    assert 'h_s_bucket{le="+Inf"} 1' in text
+    assert "h_s_sum 0.5" in text
+    assert "h_s_count 1" in text
+    # every non-comment line: <name or name{labels}> <number>
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        float(value)
+        assert name_part[0].isalpha()
+
+
+def test_registry_thread_safety():
+    r = Registry()
+    c = r.counter("n_total")
+    fam = r.counter("l_total", label_names=("k",))
+    h = r.histogram("h_s", buckets=(0.5,))
+    threads, per = 8, 2000
+
+    def work(i):
+        for _ in range(per):
+            c.inc()
+            fam.labels(k=str(i % 2)).inc()
+            h.observe(0.1)
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == threads * per
+    total = sum(child.value for child in fam.children().values())
+    assert total == threads * per
+    assert h._solo().count == threads * per
+
+
+def test_catalog_preseeds_wire_methods():
+    # Every known wire method has a zero-valued server-requests child
+    # from import time, so /metrics shows the full set with no traffic.
+    fam = REGISTRY.get("gol_server_requests_total")
+    have = {k[0] for k in fam.children()}
+    assert set(catalog.WIRE_METHODS) <= have
+    assert catalog.method_label("Ping") == "Ping"
+    assert catalog.method_label("NoSuchMethod") == "unknown"
+
+
+# ------------------------------------------------------------ run report
+
+
+def test_run_report_schema_validation(tmp_path):
+    rep = RunReporter(str(tmp_path / "r.jsonl"), run_id="t")
+    rep.emit("run_start", w=64, h=64)
+    rep.emit("chunk", turn=8, turns=8, wall_s=0.1, cups=1e6)
+    rep.emit("traced_chunk", turn=16, turns=8)
+    rep.emit("bench_leg", value=42.0, metric="x", unit="u")
+    rep.emit("run_end", turn=16, turns_total=16, chunks=1)
+    rep.close()
+    recs = list(read_report(str(tmp_path / "r.jsonl")))
+    assert [r["event"] for r in recs] == [
+        "run_start", "chunk", "traced_chunk", "bench_leg", "run_end"]
+    assert all(r["schema"] == SCHEMA for r in recs)
+
+    good = recs[1]
+    for bad in (
+        {**good, "schema": "nope/9"},
+        {**good, "event": "mystery"},
+        {k: v for k, v in good.items() if k != "turns"},
+        {**good, "wall_s": -1},
+        {**good, "turns": 0},
+        {**good, "cups": "fast"},
+        {**good, "run_id": ""},
+        [],
+    ):
+        with pytest.raises(ValueError):
+            validate_record(bad)
+    # extra keys are fine — the schema grows by addition
+    validate_record({**good, "novel_field": 1})
+
+
+def test_run_report_bad_line_rejected(tmp_path):
+    p = tmp_path / "r.jsonl"
+    p.write_text('{"schema": "gol-run-report/1"}\nnot json\n')
+    with pytest.raises(ValueError):
+        list(read_report(str(p)))
+
+
+def test_reporter_never_raises_on_bad_path(tmp_path):
+    rep = RunReporter(str(tmp_path / "no" / "such" / "dir" / "r.jsonl"))
+    rep.emit("run_start", w=1, h=1)  # must not raise
+    rep.emit("run_end", turn=0, turns_total=0, chunks=0)
+    rep.close()
+
+
+# -------------------------------------------------- engine integration
+
+
+def _gauge(name):
+    fam = REGISTRY.get(name)
+    return fam.value if fam is not None else None
+
+
+def test_engine_run_emits_chunk_timeline(tmp_path, monkeypatch):
+    from gol_tpu.engine import Engine
+    from gol_tpu.params import Params
+
+    report = str(tmp_path / "run.jsonl")
+    monkeypatch.setenv(RUN_REPORT_ENV, report)
+    eng = Engine()
+    p = Params(threads=2, image_width=32, image_height=32, turns=25)
+    _out, turn = eng.server_distributor(p, board())
+    assert turn == 25
+
+    recs = list(read_report(report))  # validates every record
+    events = [r["event"] for r in recs]
+    assert events[0] == "run_start" and events[-1] == "run_end"
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    assert chunks, "a 25-turn run must retire at least one chunk"
+    for c in chunks:
+        assert c["turns"] >= 1
+        assert c["wall_s"] >= 0
+        assert c["cups"] >= 0
+        assert {"token_wait_s", "dispatch_s", "flag_s",
+                "alive", "chunk_size"} <= set(c)
+    start, end = recs[0], recs[-1]
+    assert (start["w"], start["h"]) == (32, 32)
+    assert end["turn"] == 25
+    assert end["turns_total"] == 25
+    assert sum(c["turns"] for c in chunks) == 25
+    # chunk records carry the exact published pairs, in turn order
+    assert [c["turn"] for c in chunks] == sorted(c["turn"] for c in chunks)
+
+    # metric gauges landed on the final state
+    assert _gauge("gol_engine_turn") == 25
+    assert _gauge("gol_engine_published_turn") == 25
+    assert _gauge("gol_engine_published_turn_regressions_total") == 0
+
+
+def test_published_turn_monotonic_and_fresh(monkeypatch):
+    """Satellite contract: the metrics snapshot never shows a published
+    (alive, turn) pair older than the last alive_count() event, and the
+    published-turn gauge is monotone within a run."""
+    from gol_tpu.engine import Engine
+    from gol_tpu.params import Params
+
+    monkeypatch.setenv("GOL_MAX_CHUNK", "4")  # many publications
+    eng = Engine()
+    p = Params(threads=1, image_width=32, image_height=32, turns=400)
+    done = threading.Event()
+
+    def run():
+        try:
+            eng.server_distributor(p, board())
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    last_event_turn = -1
+    fam = REGISTRY.get("gol_engine_published_turn")
+    while not done.is_set():
+        _alive, ev_turn = eng.alive_count()  # the ticker's source
+        snap_turn = fam.value  # read AFTER the event
+        assert snap_turn >= ev_turn, (
+            f"snapshot {snap_turn} older than event {ev_turn}")
+        assert ev_turn >= 0
+        last_event_turn = max(last_event_turn, ev_turn)
+    t.join(30)
+    assert fam.value >= last_event_turn
+    assert _gauge("gol_engine_published_turn_regressions_total") == 0
+
+
+def test_publish_regression_is_counted_not_published_backwards():
+    from gol_tpu.engine import Engine
+
+    eng = Engine()
+    fam = REGISTRY.get("gol_engine_published_turn")
+    reg = REGISTRY.get("gol_engine_published_turn_regressions_total")
+    before = reg.value
+    with eng._state_lock:
+        eng._publish_locked(10, 100, reset_floor=True)
+        assert fam.value == 100
+        eng._publish_locked(11, 90)  # out of order within the run
+    assert reg.value == before + 1
+    assert fam.value == 100, "gauge must not move backwards in-run"
+    assert eng._alive_pub == (11, 90)  # state itself still updates
+    with eng._state_lock:
+        eng._publish_locked(5, 0, reset_floor=True)  # new run may rewind
+    assert fam.value == 0
+    assert reg.value == before + 1
+
+
+def test_traced_chunk_excluded_from_pace_aggregates(tmp_path,
+                                                    monkeypatch):
+    """GOL_TRACE chunks must stay out of the timeline pace/CUPS
+    aggregates: they emit `traced_chunk` records with no wall_s/cups,
+    and neither the chunk counter nor the chunk-seconds histogram
+    moves for them."""
+    from gol_tpu.engine import TRACE_ENV, Engine
+    from gol_tpu.params import Params
+
+    report = str(tmp_path / "run.jsonl")
+    monkeypatch.setenv(RUN_REPORT_ENV, report)
+    monkeypatch.setenv(TRACE_ENV, str(tmp_path / "trace"))
+    monkeypatch.setenv("GOL_MAX_CHUNK", "8")  # several chunks
+    chunks_before = _gauge("gol_engine_chunks_total")
+    hist_before = REGISTRY.get("gol_engine_chunk_seconds")._solo().count
+    traced_before = _gauge("gol_engine_traced_chunks_total")
+
+    eng = Engine()
+    p = Params(threads=1, image_width=32, image_height=32, turns=40)
+    _out, turn = eng.server_distributor(p, board())
+    assert turn == 40
+
+    recs = list(read_report(report))
+    chunk_recs = [r for r in recs if r["event"] == "chunk"]
+    traced = [r for r in recs if r["event"] == "traced_chunk"]
+    assert len(traced) == 1
+    assert "wall_s" not in traced[0] and "cups" not in traced[0]
+    # all 40 turns accounted for, split between the two record kinds
+    assert (sum(c["turns"] for c in chunk_recs)
+            + sum(c["turns"] for c in traced)) == 40
+    # counters moved only for untraced chunks; the latency histogram
+    # saw exactly the untraced chunk count
+    assert _gauge("gol_engine_chunks_total") - chunks_before == \
+        len(chunk_recs)
+    assert _gauge("gol_engine_traced_chunks_total") - traced_before == 1
+    hist_after = REGISTRY.get("gol_engine_chunk_seconds")._solo().count
+    assert hist_after - hist_before == len(chunk_recs)
+
+
+# ------------------------------------------------------- control plane
+
+
+def test_metrics_http_endpoint():
+    from gol_tpu.obs.http import start_metrics_server
+
+    catalog.ENGINE_TURN.set(123)
+    srv = start_metrics_server(0)
+    try:
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "# TYPE gol_engine_turn gauge" in body
+        assert "gol_engine_turn 123" in body
+        assert "# TYPE gol_server_requests_total counter" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+    finally:
+        srv.close()
+
+
+def test_wire_and_server_counters_and_get_metrics(monkeypatch):
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import Engine
+    from gol_tpu.server import EngineServer
+
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    ping_before = catalog.SERVER_REQUESTS.labels(method="Ping").value
+    cli_before = catalog.CLIENT_REQUESTS.labels(method="Ping").value
+    bytes_before = catalog.WIRE_BYTES.labels(direction="sent").value
+
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    try:
+        eng = RemoteEngine(f"127.0.0.1:{srv.port}")
+        assert eng.ping() == 0
+        assert eng.ping() == 0
+        snap = eng.get_metrics()
+    finally:
+        srv.shutdown()
+
+    assert catalog.SERVER_REQUESTS.labels(method="Ping").value \
+        == ping_before + 2
+    assert catalog.CLIENT_REQUESTS.labels(method="Ping").value \
+        == cli_before + 2
+    assert catalog.WIRE_BYTES.labels(direction="sent").value > bytes_before
+    lat = catalog.SERVER_REQUEST_SECONDS.labels(method="Ping")
+    assert lat.count >= 2
+
+    # GetMetrics returns the server's own snapshot, JSON-round-tripped
+    assert snap["gol_server_requests_total"]["type"] == "counter"
+    ping_vals = [v for v in snap["gol_server_requests_total"]["values"]
+                 if v["labels"] == {"method": "Ping"}]
+    assert ping_vals and ping_vals[0]["value"] >= 2
+    # snapshot taken before the GetMetrics reply was sent, so its own
+    # method shows up as requested at least once
+    gm = [v for v in snap["gol_server_requests_total"]["values"]
+          if v["labels"] == {"method": "GetMetrics"}]
+    assert gm and gm[0]["value"] >= 1
+
+
+# ------------------------------------------------------ structured log
+
+
+def test_structured_log_json_and_text(monkeypatch, capsys):
+    # obs/__init__ re-exports the log() function, shadowing the module
+    # as a package attribute — fetch the module itself.
+    import importlib
+    obs_log = importlib.import_module("gol_tpu.obs.log")
+
+    monkeypatch.setenv("GOL_LOG", "json")
+    obs_log.log("unit.test", level="info", value=7)
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        obs_log.exception("unit.fail", e)
+    err = capsys.readouterr().err
+    lines = [json.loads(line) for line in err.strip().splitlines()]
+    assert lines[0]["event"] == "unit.test" and lines[0]["value"] == 7
+    assert lines[1]["level"] == "error"
+    assert "RuntimeError: boom" in lines[1]["error"]
+    assert "Traceback" in lines[1]["traceback"]
+
+    monkeypatch.setenv("GOL_LOG", "text")
+    obs_log.log("unit.text", extra="x")
+    err = capsys.readouterr().err
+    assert "[gol:info] unit.text extra=x" in err
+
+    monkeypatch.delenv("GOL_LOG")  # default is text
+    obs_log.log("unit.default")
+    assert "[gol:info] unit.default" in capsys.readouterr().err

@@ -1,0 +1,98 @@
+"""obs-smoke: prove the observability plumbing end to end on CPU.
+
+Runs a tiny board through the real CLI with `--run-report` and
+`--metrics-port 0`, then validates BOTH outputs:
+
+  * the run report parses as schema gol-run-report/1 and contains at
+    least one chunk record with wall/turns/CUPS populated, bracketed by
+    run_start/run_end;
+  * the `/metrics` endpoint serves parseable Prometheus text including
+    the engine turn/CUPS gauges and the wire/server counter families.
+
+Runs IN-PROCESS (main() is called, not subprocessed) so the ephemeral
+metrics port is discoverable without output scraping, and stays inside
+the tier-1 time budget. Exit 0 = pass.
+
+    make obs-smoke      # JAX_PLATFORMS=cpu python tools/obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import urllib.request
+
+# Runnable as `python tools/obs_smoke.py` from a bare clone: put the
+# repo root (this file's parent's parent) ahead of tools/ on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    report = os.path.join(
+        tempfile.mkdtemp(prefix="gol_obs_smoke_"), "run.jsonl")
+
+    from gol_tpu.main import main as gol_main
+
+    rc = gol_main(["-w", "64", "-h", "64", "--turns", "64",
+                   "--rle", "rpentomino", "--headless", "-t", "1",
+                   "--run-report", report, "--metrics-port", "0"])
+    if rc != 0:
+        print(f"obs-smoke: CLI run failed rc={rc}", file=sys.stderr)
+        return 1
+
+    # ---- run report ----------------------------------------------------
+    from gol_tpu.obs.timeline import read_report
+
+    recs = list(read_report(report))  # raises on any schema violation
+    events = [r["event"] for r in recs]
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    problems = []
+    if events[0] != "run_start" or events[-1] != "run_end":
+        problems.append(f"bad bookends: {events[:1]} ... {events[-1:]}")
+    if not chunks:
+        problems.append("no chunk records")
+    for c in chunks:
+        if c["turns"] <= 0 or c["wall_s"] < 0 or c["cups"] < 0:
+            problems.append(f"bad chunk record: {c}")
+    if recs and recs[-1]["event"] == "run_end" and recs[-1]["turn"] != 64:
+        problems.append(f"run_end turn {recs[-1]['turn']} != 64")
+
+    # ---- /metrics ------------------------------------------------------
+    from gol_tpu.obs.http import last_server
+
+    srv = last_server()
+    if srv is None:
+        problems.append("metrics server did not start")
+    else:
+        body = urllib.request.urlopen(srv.url, timeout=10).read().decode()
+        for needle in ("# TYPE gol_engine_turn gauge",
+                       "# TYPE gol_engine_cups gauge",
+                       "# TYPE gol_server_requests_total counter",
+                       "# TYPE gol_wire_bytes_total counter",
+                       "gol_engine_chunk_seconds_bucket"):
+            if needle not in body:
+                problems.append(f"/metrics missing {needle!r}")
+        for line in body.splitlines():
+            if line.startswith("gol_engine_turn "):
+                if float(line.split()[-1]) != 64:
+                    problems.append(f"engine turn gauge: {line!r}")
+                break
+        else:
+            problems.append("no gol_engine_turn sample")
+        srv.close()
+
+    if problems:
+        for p in problems:
+            print(f"obs-smoke: FAIL: {p}", file=sys.stderr)
+        return 1
+    print(f"obs-smoke: OK — {len(chunks)} chunk record(s), "
+          f"/metrics served {len(body)} bytes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
