@@ -650,6 +650,28 @@ def main() -> int:
 
         global _SELF_REPORTER
         _SELF_REPORTER = RunReporter(args.self_report)
+        # Stamp the software/hardware identity into the run_start
+        # bookend so bench JSON lines are comparable across hosts
+        # (schema requires numeric w/h; a bench run has no board, so
+        # they are 0). Version probing must never sink the bench.
+        ident = {}
+        try:
+            import jax
+            import jaxlib
+
+            ident["jax"] = jax.__version__
+            ident["jaxlib"] = jaxlib.__version__
+            ident["device_kind"] = jax.devices()[0].device_kind
+        except Exception as e:
+            ident["ident_error"] = f"{type(e).__name__}: {e}"
+        try:
+            import platform
+
+            ident["host"] = platform.node()
+        except Exception:
+            pass
+        _SELF_REPORTER.emit("run_start", w=0, h=0, source="bench",
+                            **ident)
     # Same entry-point cache policy as the CLI/server: the bench compiles
     # ~a dozen distinct programs per matrix run (timed lengths, warmups,
     # parity replays, the sparse ladder); the persistent cache turns

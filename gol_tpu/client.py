@@ -29,6 +29,9 @@ import numpy as np
 
 from gol_tpu.engine import EngineBusy, EngineKilled
 from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import flight as obs_flight
+from gol_tpu.obs import trace
+from gol_tpu.obs.log import log as obs_log
 from gol_tpu.params import Params
 from gol_tpu.utils.envcfg import env_float, env_int
 from gol_tpu.wire import recv_msg, send_msg
@@ -75,21 +78,25 @@ class RemoteEngine:
         label = obs.method_label(str(header.get("method")))
         obs.CLIENT_REQUESTS.labels(method=label).inc()
         t0 = time.monotonic()
-        try:
-            sock = socket.create_connection(
-                self._addr, timeout=self._timeout)
+        # The span sits on this thread's context stack while send_msg
+        # runs, so the wire codec stamps its id into the header as "tc"
+        # and the server handler span parents under it.
+        with trace.span(f"rpc.{label}"):
             try:
-                sock.settimeout(timeout)  # None → block (long run call)
-                send_msg(sock, header, world)
-                resp, resp_world = recv_msg(sock)
+                sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
+                try:
+                    sock.settimeout(timeout)  # None → block (long run call)
+                    send_msg(sock, header, world)
+                    resp, resp_world = recv_msg(sock)
+                finally:
+                    sock.close()
+            except (ConnectionError, OSError):
+                obs.CLIENT_ERRORS.labels(method=label).inc()
+                raise
             finally:
-                sock.close()
-        except (ConnectionError, OSError):
-            obs.CLIENT_ERRORS.labels(method=label).inc()
-            raise
-        finally:
-            obs.CLIENT_REQUEST_SECONDS.labels(method=label).observe(
-                time.monotonic() - t0)
+                obs.CLIENT_REQUEST_SECONDS.labels(method=label).observe(
+                    time.monotonic() - t0)
         _check_resp(resp)
         return resp, resp_world
 
@@ -130,27 +137,46 @@ class RemoteEngine:
         stop = threading.Event()
         lost = threading.Event()
 
+        # The blocking-run span: every watchdog probe parents under it,
+        # and its id rides the wire so the server handler span joins the
+        # same trace.
+        run_span = trace.start(
+            "rpc.ServerDistributor",
+            attrs={"addr": f"{self._addr[0]}:{self._addr[1]}",
+                   "turns": params.turns, "start_turn": start_turn})
+        run_ctx = run_span.context()
+
         def watchdog() -> None:
             misses = 0
             while not stop.wait(hb_interval):
-                try:
-                    self.ping()
-                    misses = 0
-                except (EngineKilled, RuntimeError):
-                    return  # engine reachable (killed/errored ≠ lost)
-                except (ConnectionError, OSError):
-                    misses += 1
-                    if misses >= hb_misses:
-                        lost.set()
-                        try:
-                            sock.shutdown(socket.SHUT_RDWR)
-                        except OSError:
-                            pass
-                        sock.close()
-                        return
+                with trace.span("hb.probe", parent=run_ctx) as probe:
+                    try:
+                        self.ping()
+                        misses = 0
+                    except (EngineKilled, RuntimeError):
+                        return  # engine reachable (killed/errored ≠ lost)
+                    except (ConnectionError, OSError):
+                        misses += 1
+                        probe.attrs["miss"] = misses
+                        if misses >= hb_misses:
+                            lost.set()
+                            run_span.attrs["lost"] = True
+                            # The in-flight run span is exactly what a
+                            # post-mortem needs: dump before we yank the
+                            # socket out from under it.
+                            obs_log("client.heartbeat_lost", level="error",
+                                    misses=misses, interval_s=hb_interval)
+                            obs_flight.FLIGHT.dump("watchdog")
+                            try:
+                                sock.shutdown(socket.SHUT_RDWR)
+                            except OSError:
+                                pass
+                            sock.close()
+                            return
 
         obs.CLIENT_REQUESTS.labels(method="ServerDistributor").inc()
         t0 = time.monotonic()
+        trace.TRACER.push(run_span)
         try:
             sock.settimeout(None)  # block for the whole run
             # Watchdog up BEFORE the upload: a partition mid-send of a
@@ -169,6 +195,8 @@ class RemoteEngine:
             raise
         finally:
             stop.set()
+            trace.TRACER.pop(run_span)
+            trace.finish(run_span)
             obs.CLIENT_REQUEST_SECONDS.labels(
                 method="ServerDistributor").observe(time.monotonic() - t0)
             try:

@@ -30,6 +30,7 @@ from gol_tpu.engine import (
     FLAG_QUIT,
 )
 from gol_tpu.io.pgm import input_path, output_path, read_pgm, write_pgm
+from gol_tpu.obs import trace as obs_trace
 from gol_tpu.params import Params
 from gol_tpu.utils.cell import alive_cells_from_board
 from gol_tpu.utils.envcfg import env_float, env_int
@@ -190,6 +191,17 @@ def distributor(
         live_view = False
 
     width, height = p.image_width, p.image_height
+    # The trace root for the whole run: it rides this thread's context
+    # stack (so the submit below — local engine.run or remote
+    # rpc.ServerDistributor — parents under it) and its captured context
+    # is handed to the helper threads, whose keypress/ticker spans would
+    # otherwise each start an unrelated trace.
+    run_span = obs_trace.start(
+        "controller.run",
+        attrs={"w": width, "h": height, "turns": p.turns,
+               "sparse": bool(sparse)})
+    obs_trace.TRACER.push(run_span)
+    root_ctx = run_span.context()
     done = threading.Event()
     helper_threads: list = []
     kp_state = {"k": False}
@@ -222,8 +234,14 @@ def distributor(
 
         pgm_levels = (tuple(gray_levels(io_rule).tolist())
                       if isinstance(io_rule, GenerationsRule) else None)
-    except BaseException:
+    except BaseException as e:
         done.set()
+        # The run span was already pushed: unwind it or it stays on this
+        # thread's context stack forever and every later send_msg from
+        # this thread would inherit a dead trace context.
+        obs_trace.TRACER.pop(run_span)
+        obs_trace.finish(run_span,
+                         error=e if isinstance(e, Exception) else None)
         events_q.put(ev.CLOSE)
         raise
 
@@ -235,48 +253,57 @@ def distributor(
             except queue.Empty:
                 continue
             try:
-                if key == "s":
-                    if sparse:
-                        win, _, turn = engine.get_window()
-                        fname = output_path(
-                            win.shape[1], win.shape[0], turn, out_dir)
-                        write_pgm(fname, win)
-                    else:
-                        world, turn = engine.get_world()
-                        fname = output_path(width, height, turn, out_dir)
-                        write_pgm(fname, world, levels=pgm_levels)
-                    events_q.put(
-                        ev.ImageOutputComplete(turn, os.path.basename(fname))
-                    )
-                elif key == "p":
-                    if in_recovery.is_set():
-                        continue  # see in_recovery above
-                    engine.cf_put(FLAG_PAUSE)
-                    # The flag is committed: toggle shared state BEFORE
-                    # the (fallible) turn poll, or a transient failure
-                    # there would leave controller and engine
-                    # pause-inverted for the rest of the run.
-                    paused = not pause_requested.is_set()
-                    if paused:
-                        pause_requested.set()
-                    else:
-                        pause_requested.clear()
-                    try:
-                        _, turn = engine.alive_count()
-                    except (ConnectionError, OSError, RuntimeError):
-                        turn = 0
-                    if paused:
-                        events_q.put(ev.StateChange(turn, ev.State.PAUSED))
-                    else:
-                        print("Continuing")
+                # One span per handled keypress, parented to the run
+                # root (this thread's own stack is empty). Names clamp
+                # to the known keys — span names must stay bounded.
+                kname = key if key in ("s", "p", "q", "k") else "other"
+                with obs_trace.span(f"controller.key.{kname}",
+                                    parent=root_ctx):
+                    if key == "s":
+                        if sparse:
+                            win, _, turn = engine.get_window()
+                            fname = output_path(
+                                win.shape[1], win.shape[0], turn, out_dir)
+                            write_pgm(fname, win)
+                        else:
+                            world, turn = engine.get_world()
+                            fname = output_path(
+                                width, height, turn, out_dir)
+                            write_pgm(fname, world, levels=pgm_levels)
                         events_q.put(
-                            ev.StateChange(turn, ev.State.EXECUTING)
+                            ev.ImageOutputComplete(
+                                turn, os.path.basename(fname))
                         )
-                elif key == "q":
-                    engine.cf_put(FLAG_QUIT)
-                elif key == "k":
-                    kp_state["k"] = True
-                    engine.cf_put(FLAG_KILL)
+                    elif key == "p":
+                        if in_recovery.is_set():
+                            continue  # see in_recovery above
+                        engine.cf_put(FLAG_PAUSE)
+                        # The flag is committed: toggle shared state
+                        # BEFORE the (fallible) turn poll, or a transient
+                        # failure there would leave controller and engine
+                        # pause-inverted for the rest of the run.
+                        paused = not pause_requested.is_set()
+                        if paused:
+                            pause_requested.set()
+                        else:
+                            pause_requested.clear()
+                        try:
+                            _, turn = engine.alive_count()
+                        except (ConnectionError, OSError, RuntimeError):
+                            turn = 0
+                        if paused:
+                            events_q.put(
+                                ev.StateChange(turn, ev.State.PAUSED))
+                        else:
+                            print("Continuing")
+                            events_q.put(
+                                ev.StateChange(turn, ev.State.EXECUTING)
+                            )
+                    elif key == "q":
+                        engine.cf_put(FLAG_QUIT)
+                    elif key == "k":
+                        kp_state["k"] = True
+                        engine.cf_put(FLAG_KILL)
             except EngineKilled:
                 return
             except (ConnectionError, OSError):
@@ -301,7 +328,8 @@ def distributor(
     def ticker_loop() -> None:
         while not done.wait(ALIVE_POLL_SECONDS):
             try:
-                alive, turn = engine.alive_count()
+                with obs_trace.span("controller.tick", parent=root_ctx):
+                    alive, turn = engine.alive_count()
             except EngineKilled:
                 return
             except (ConnectionError, OSError, RuntimeError):
@@ -671,6 +699,8 @@ def distributor(
         events_q.put(ev.StateChange(final_turn, ev.State.QUITTING))
     finally:
         done.set()
+        obs_trace.TRACER.pop(run_span)
+        obs_trace.finish(run_span)
         events_q.put(ev.CLOSE)
         # Bounded join of the helper threads AFTER CLOSE is delivered:
         # a daemon ticker still inside a device fetch when the process

@@ -49,7 +49,9 @@ import numpy as np
 from gol_tpu.models.generations import GenerationsRule
 from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import flight as obs_flight
 from gol_tpu.obs import timeline as obs_timeline
+from gol_tpu.obs import trace as obs_trace
 from gol_tpu.ops.bitpack import pack, packed_alive_count, unpack
 from gol_tpu.ops.stencil import alive_count_exact, from_pixels, to_pixels
 from gol_tpu.params import Params
@@ -808,7 +810,7 @@ class Engine(ControlFlagProtocol):
             windowed-rate once the pipeline is open)."""
             nonlocal chunk, last_pop, ramping, flag_pending
             (_done_cells, done_token, done_k, done_turn,
-             done_issue) = inflight.popleft()
+             done_issue, done_span) = inflight.popleft()
             t_wait = time.monotonic()
             done_alive = int(np.asarray(
                 jax.device_get(done_token), dtype=np.int64).sum())
@@ -860,6 +862,22 @@ class Engine(ControlFlagProtocol):
                     dispatch_s=round(done_issue, 6),
                     flag_s=round(flag_pending, 6), alive=done_alive)
             flag_pending = 0.0
+            # The chunk span opened at issue closes here: it covers
+            # dispatch + device compute + token wait, i.e. the chunk's
+            # life in the pipeline, not just the host-side blocking.
+            done_span.attrs.update(alive=done_alive,
+                                   token_wait_s=round(token_wait, 6))
+            obs_trace.finish(done_span)
+
+        # The run span: parents every chunk/flag span below, and itself
+        # parents under whatever is on this thread's context stack — the
+        # server's ServerDistributor handler span for remote runs, the
+        # controller's run span for in-process ones.
+        run_span = obs_trace.start(
+            "engine.run", attrs={"w": width, "h": height,
+                                 "turns": params.turns,
+                                 "start_turn": start_turn, "repr": repr_})
+        obs_trace.TRACER.push(run_span)
         try:
             while self._turn < target and not quit_run:
                 if self._killed or self._abort.is_set():
@@ -876,9 +894,11 @@ class Engine(ControlFlagProtocol):
                 if trace_now:
                     while inflight:  # a clean profile: nothing else queued
                         _pop_oldest()
-                    with jax.profiler.trace(trace_dir):
-                        cells = run(cells, k, mesh, self._rule)
-                        wait(cells)
+                    with obs_trace.span("engine.traced_chunk",
+                                        attrs={"k": k}):
+                        with jax.profiler.trace(trace_dir):
+                            cells = run(cells, k, mesh, self._rule)
+                            wait(cells)
                     trace_dir = ""
                     traced_chunks += 1
                     obs.ENGINE_TRACED_CHUNKS_TOTAL.inc()
@@ -893,6 +913,13 @@ class Engine(ControlFlagProtocol):
                     _reset_pace(time.monotonic())
                 else:
                     t_issue = time.monotonic()
+                    # Opened at issue, finished by _pop_oldest — the
+                    # span rides the pipeline with its chunk (6th tuple
+                    # element) so a flight dump mid-run shows exactly
+                    # which turns were in flight on the device.
+                    chunk_span = obs_trace.start(
+                        "engine.chunk",
+                        attrs={"k": k, "turn": self._turn + k})
                     cells, token = tokened(cells, k)
                     issue_cost = time.monotonic() - t_issue
                     if issue_cost > 0.05:
@@ -913,7 +940,8 @@ class Engine(ControlFlagProtocol):
                     # variance.
                     token.copy_to_host_async()
                     inflight.append(
-                        (cells, token, k, self._turn + k, issue_cost))
+                        (cells, token, k, self._turn + k, issue_cost,
+                         chunk_span))
                     while len(inflight) >= (1 if ramping else depth):
                         _pop_oldest()
                 chunks_done += 1
@@ -929,13 +957,21 @@ class Engine(ControlFlagProtocol):
                     # Only honour flags while turns remain — a pause landing
                     # with the final chunk must not park a finished run.
                     t_flags = time.monotonic()
-                    quit_run = self._handle_flags()
+                    with obs_trace.span("engine.flags"):
+                        quit_run = self._handle_flags()
                     flag_cost = time.monotonic() - t_flags
                     obs.ENGINE_FLAG_SERVICE_SECONDS.observe(flag_cost)
                     flag_pending += flag_cost
                     if flag_cost > 0.01:
                         # A pause (or slow flag drain) stalled the host.
                         _reset_pace(time.monotonic())
+        except Exception as e:
+            # The black box: an unhandled chunk-loop error dumps the
+            # flight ring — recent spans/events plus the chunk spans
+            # still riding the pipeline — before the error propagates
+            # to the dispatcher.
+            obs_flight.crash("engine.run_loop", e, turn=self._turn)
+            raise
         finally:
             # Drain remaining in-flight chunks so the LAST publication is
             # the final state's exact (alive, turn) — the chunks are
@@ -945,7 +981,11 @@ class Engine(ControlFlagProtocol):
                 while inflight:
                     _pop_oldest()
             except Exception:
-                inflight.clear()  # device error: return what we have
+                # Device error: return what we have. Close the orphaned
+                # chunk spans so they don't read as in-flight forever.
+                for _item in inflight:
+                    obs_trace.finish(_item[5])
+                inflight.clear()
             # The traced chunk (and a turns=0 run) bypass the token, so
             # the drained publication can trail the final turn by one
             # chunk: reconcile with one dispatch, on the run thread, once
@@ -981,6 +1021,9 @@ class Engine(ControlFlagProtocol):
                     traced_chunks=traced_chunks,
                     wall_s=round(time.monotonic() - run_t0, 6))
                 reporter.close()
+            run_span.attrs["final_turn"] = final_turn
+            obs_trace.TRACER.pop(run_span)
+            obs_trace.finish(run_span)
         # On kill_prog mid-run, still hand back the partial board — the
         # state exists and discarding completed turns helps nobody; further
         # RPCs on this engine raise EngineKilled.

@@ -46,6 +46,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="serve Prometheus text on "
                          "http://127.0.0.1:PORT/metrics (0 = ephemeral "
                          "port; unset = no endpoint)")
+    ap.add_argument("--trace-spans", metavar="PATH", default="",
+                    help="export controller/RPC/engine spans as Chrome "
+                         "trace-event JSON (Perfetto-loadable) to PATH "
+                         "when the run ends; equivalent to "
+                         "GOL_TRACE_SPANS=PATH (a directory gets one "
+                         "file per pid)")
     ap.add_argument("--rule", metavar="RULE", default="",
                     help="rulestring for the in-process engine: life-like"
                          " 'B36/S23' (HighLife) or Generations "
@@ -144,6 +150,11 @@ def main(argv=None) -> int:
         from gol_tpu.obs.timeline import RUN_REPORT_ENV
 
         os.environ[RUN_REPORT_ENV] = args.run_report
+    from gol_tpu.obs import trace as obs_trace
+
+    if args.trace_spans:
+        os.environ[obs_trace.TRACE_SPANS_ENV] = args.trace_spans
+    obs_trace.set_process_name("gol-controller")
     if args.metrics_port is not None:
         from gol_tpu.obs.http import start_metrics_server
         from gol_tpu.obs.log import log as obs_log
@@ -204,6 +215,9 @@ def main(argv=None) -> int:
             images_dir=images_dir, sparse=args.sparse)
     view_start(p, events_q, key_presses, headless=args.headless)
     t.join(30)
+    # Export whatever spans the run recorded (no-op without
+    # --trace-spans / GOL_TRACE_SPANS; never raises).
+    obs_trace.export_from_env()
     if t.exception is not None:
         # The run failed (bad rule, missing image, engine error): the
         # thread printed its traceback; the CLI must exit non-zero

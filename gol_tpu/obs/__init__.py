@@ -6,13 +6,18 @@ pulling a device runtime. See docs/OBSERVABILITY.md.
 """
 
 from gol_tpu.obs import catalog  # declare every metric family up front
+from gol_tpu.obs import flight, trace
+from gol_tpu.obs.flight import FLIGHT, FlightRecorder
 from gol_tpu.obs.log import exception, log
 from gol_tpu.obs.metrics import REGISTRY, Registry, get_registry
 from gol_tpu.obs.timeline import (RUN_REPORT_ENV, SCHEMA, RunReporter,
                                   from_env, read_report, validate_record)
+from gol_tpu.obs.trace import TRACER, Span, Tracer
 
 __all__ = [
     "catalog", "REGISTRY", "Registry", "get_registry",
     "RunReporter", "from_env", "read_report", "validate_record",
     "RUN_REPORT_ENV", "SCHEMA", "log", "exception",
+    "trace", "flight", "TRACER", "Tracer", "Span",
+    "FLIGHT", "FlightRecorder",
 ]

@@ -119,3 +119,29 @@ for _m in WIRE_METHODS:
 def method_label(method: str) -> str:
     """Clamp arbitrary header method strings to the declared set."""
     return method if method in WIRE_METHODS else "unknown"
+
+
+# ------------------------------------------------- tracing / flight recorder
+
+TRACE_SPANS_TOTAL = REGISTRY.counter(
+    "gol_trace_spans_total",
+    "Spans finished by the in-process span tracer (obs/trace.py).")
+TRACE_SPAN_DROPS_TOTAL = REGISTRY.counter(
+    "gol_trace_span_drops_total",
+    "Finished spans dropped because the tracer's export buffer was full "
+    "(the flight-recorder ring still keeps the most recent tail).")
+FLIGHT_DUMPS_TOTAL = REGISTRY.counter(
+    "gol_flight_dumps_total",
+    "Flight-recorder dumps written, by trigger reason.",
+    label_names=("reason",))
+
+# Same cardinality discipline as wire methods: reasons are clamped to a
+# declared set and pre-seeded at zero.
+FLIGHT_REASONS = ("sigterm", "watchdog", "exception", "manual", "unknown")
+for _r in FLIGHT_REASONS:
+    FLIGHT_DUMPS_TOTAL.labels(reason=_r)
+
+
+def flight_reason_label(reason: str) -> str:
+    """Clamp arbitrary dump reasons to the declared set."""
+    return reason if reason in FLIGHT_REASONS else "unknown"

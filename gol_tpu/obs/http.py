@@ -1,36 +1,68 @@
-"""Optional `/metrics` endpoint: stdlib http.server, daemon thread.
+"""Optional HTTP endpoints: stdlib http.server, daemon thread.
 
-`start_metrics_server(port)` binds (port 0 = OS-assigned ephemeral),
-serves Prometheus text from the shared registry on GET /metrics, and
-returns the running server object — `.port` tells callers (and the
+`start_metrics_server(port)` binds (port 0 = OS-assigned ephemeral) and
+serves three read-only paths from in-process state:
+
+  * `/metrics` (and `/`) — Prometheus text from the shared registry;
+  * `/metrics.json` — the registry's dict snapshot, for tooling that
+    would rather not parse exposition text;
+  * `/healthz` — 200 + `{"run_id", "turn", "uptime_s"}`, the liveness
+    probe: run_id identifies the process, turn proves the engine loop
+    is advancing between polls.
+
+Returns the running server object — `.port` tells callers (and the
 obs-smoke harness) where an ephemeral bind landed. The thread is a
 daemon: it dies with the process and never blocks shutdown.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from gol_tpu.obs import catalog
+from gol_tpu.obs import flight as obs_flight
 from gol_tpu.obs.metrics import REGISTRY
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+JSON_CONTENT_TYPE = "application/json; charset=utf-8"
 
 # Most recent server started in this process, so in-process harnesses
 # (tools/obs_smoke.py) can find the ephemeral port after main() returns.
 _LAST: Optional["MetricsServer"] = None
 
 
+def healthz_doc() -> dict:
+    """The /healthz body (also used by tests without a socket)."""
+    return {"run_id": obs_flight.RUN_ID,
+            "turn": catalog.ENGINE_TURN.value,
+            "uptime_s": round(obs_flight.uptime_s(), 3)}
+
+
 class _Handler(BaseHTTPRequestHandler):
+    def _reply(self, body: bytes, ctype: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802 (http.server API)
-        if self.path.split("?", 1)[0] in ("/metrics", "/"):
-            body = REGISTRY.render_prometheus().encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type", PROM_CONTENT_TYPE)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            self._reply(REGISTRY.render_prometheus().encode("utf-8"),
+                        PROM_CONTENT_TYPE)
+        elif path == "/metrics.json":
+            self._reply(
+                json.dumps(REGISTRY.snapshot(), sort_keys=True,
+                           default=str).encode("utf-8"),
+                JSON_CONTENT_TYPE)
+        elif path == "/healthz":
+            self._reply(
+                json.dumps(healthz_doc(), sort_keys=True).encode("utf-8"),
+                JSON_CONTENT_TYPE)
         else:
             self.send_error(404)
 

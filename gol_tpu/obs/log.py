@@ -16,6 +16,8 @@ import time
 import traceback
 from typing import Optional
 
+from gol_tpu.obs import flight as _flight
+
 LOG_ENV = "GOL_LOG"
 
 
@@ -29,9 +31,15 @@ def _mode() -> str:
 def log(event: str, level: str = "info", stream=None, **fields) -> None:
     """Emit one structured event. `fields` must be JSON-serializable."""
     stream = stream if stream is not None else sys.stderr
+    rec = {"ts": round(time.time(), 3), "level": level, "event": event}
+    rec.update(fields)
+    # Every event also lands in the flight-recorder ring, whatever the
+    # stderr format — a crash dump should carry the recent log tail.
+    try:
+        _flight.FLIGHT.record_event(rec)
+    except Exception:
+        pass
     if _mode() == "json":
-        rec = {"ts": round(time.time(), 3), "level": level, "event": event}
-        rec.update(fields)
         line = json.dumps(rec, sort_keys=True, default=str)
     else:
         extras = " ".join(f"{k}={v}" for k, v in fields.items())

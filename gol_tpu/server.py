@@ -23,7 +23,9 @@ import numpy as np
 
 from gol_tpu.engine import Engine, EngineBusy, EngineKilled
 from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import flight as obs_flight
 from gol_tpu.obs import log as obs_log
+from gol_tpu.obs import trace
 from gol_tpu.obs.metrics import REGISTRY
 from gol_tpu.params import Params
 from gol_tpu.utils.envcfg import env_float, env_int
@@ -138,11 +140,17 @@ class EngineServer:
         label = obs.method_label(str(method))
         obs.SERVER_REQUESTS.labels(method=label).inc()
         t0 = time.monotonic()
-        try:
-            self._dispatch_inner(conn, method, label, header, world)
-        finally:
-            obs.SERVER_REQUEST_SECONDS.labels(method=label).observe(
-                time.monotonic() - t0)
+        # The handler span joins the caller's trace via the propagated
+        # "tc" header (absent/garbage → a fresh root). It sits on this
+        # connection thread's context stack for the whole dispatch, so
+        # engine spans opened inside (chunk loop, flag service) parent
+        # under it without engine.py knowing about the wire.
+        with trace.span(f"serve.{label}", parent=header.get("tc")):
+            try:
+                self._dispatch_inner(conn, method, label, header, world)
+            finally:
+                obs.SERVER_REQUEST_SECONDS.labels(method=label).observe(
+                    time.monotonic() - t0)
 
     def _dispatch_inner(
         self, conn: socket.socket, method, label: str, header: dict, world
@@ -202,7 +210,7 @@ class EngineServer:
                 # `SubServer/distributor.go:42-45`): bring the server down.
                 self.shutdown()
                 if os.environ.get("GOL_SERVER_EXIT_ON_KILL", "1") == "1":
-                    threading.Timer(0.2, lambda: os._exit(0)).start()
+                    threading.Timer(0.2, _exit_after_flush).start()
             else:
                 send_msg(conn, {"ok": False,
                                 "error": f"unknown method {method!r}"})
@@ -217,6 +225,19 @@ class EngineServer:
             send_msg(conn, {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
 
+def _final_flush(reason: str) -> None:
+    """Last writes on paths that end in os._exit (which skips atexit):
+    the flight-recorder dump and the span export. Both are no-ops unless
+    their env vars are set, and neither can raise."""
+    obs_flight.FLIGHT.dump(reason)
+    trace.export_from_env()
+
+
+def _exit_after_flush() -> None:
+    _final_flush("manual")
+    os._exit(0)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description="gol_tpu engine server")
     ap.add_argument("--port", type=int,
@@ -227,6 +248,11 @@ def main() -> None:
                     help="serve Prometheus text on "
                          "http://127.0.0.1:PORT/metrics (0 = ephemeral "
                          "port; unset = no endpoint)")
+    ap.add_argument("--trace-spans", metavar="PATH", default="",
+                    help="export handler/engine spans as Chrome "
+                         "trace-event JSON (Perfetto-loadable) to PATH "
+                         "on shutdown (sets GOL_TRACE_SPANS; a "
+                         "directory gets one file per pid)")
     ap.add_argument("--resume", metavar="CKPT", default="",
                     help="restore (world, turn) from a checkpoint .npz "
                          "before serving (pairs with GOL_CKPT autosaves)")
@@ -249,6 +275,9 @@ def main() -> None:
                          "GOL_SPARSE_SHARDS row-shards the window over "
                          "that many devices)")
     args = ap.parse_args()
+    if args.trace_spans:
+        os.environ[trace.TRACE_SPANS_ENV] = args.trace_spans
+    trace.set_process_name("gol-server")
     # Join the multi-host engine cluster FIRST: jax.distributed must
     # initialize before ANYTHING touches the XLA backend (including the
     # compile-cache block below, whose jax.default_backend() call would
@@ -300,6 +329,10 @@ def main() -> None:
                                 turn=s["turn"], path=path)
             except Exception as e:
                 obs_log.exception("server.sigterm_checkpoint_failed", e)
+        # After the checkpoint (the dump should record its log event,
+        # and a slow checkpoint must not delay the black box by dying
+        # first — dump is sub-ms either way).
+        _final_flush("sigterm")
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _on_term)
@@ -314,6 +347,9 @@ def main() -> None:
           f"({len(np.atleast_1d(srv.engine._devices))} device(s), "
           f"rule {srv.engine._rule.rulestring})")
     srv.serve_forever()
+    # Orderly stop (accept loop closed, e.g. KillProg without the exit
+    # timer): still export whatever spans were recorded.
+    trace.export_from_env()
 
 
 if __name__ == "__main__":

@@ -9,6 +9,18 @@ its own densest trivial encoding — no base64, no gob).
 
 Message: { "method"/"ok": ..., ...fields..., "world": {"h": H, "w": W}? }
 followed by exactly H*W raw payload bytes when "world" is present.
+
+Trace context: when the sending thread has an open span (obs/trace.py)
+and the header carries no explicit "tc", send_msg stamps the span's
+compact context — `"tc": {"t": <trace_id>, "s": <span_id>}` — into the
+header. The receiving dispatcher parses it with `trace.parse_context`
+and parents its handler span under the sender's, which is the whole
+cross-process propagation mechanism: one optional ~40-byte field.
+
+Byte metering happens in `finally`: a transfer that dies mid-flight
+still counts what it moved, so the byte counters stay honest during
+exactly the connection failures they exist to explain. Message counters
+still only count complete messages.
 """
 
 from __future__ import annotations
@@ -21,6 +33,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import trace
 from gol_tpu.utils.envcfg import env_int
 
 _LEN = struct.Struct(">I")
@@ -40,13 +53,25 @@ def max_board_cells() -> int:
     return env_int("GOL_MAX_BOARD_CELLS", DEFAULT_MAX_BOARD_CELLS)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+class _Tally:
+    """Mutable byte count shared with a `finally` meter."""
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                tally: Optional[_Tally] = None) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(n - len(buf), 1 << 20))
         if not chunk:
             raise ConnectionError("peer closed mid-message")
         buf.extend(chunk)
+        if tally is not None:
+            tally.n += len(chunk)
     return bytes(buf)
 
 
@@ -55,6 +80,10 @@ def send_msg(
 ) -> int:
     """Send one message; returns the bytes put on the wire."""
     header = dict(header)
+    if "tc" not in header:
+        tc = trace.context()
+        if tc is not None:
+            header["tc"] = tc
     payload = None
     if world is not None:
         if world.dtype != np.uint8 or world.ndim != 2:
@@ -65,47 +94,63 @@ def send_msg(
         # transiently double a multi-GB snapshot.
         payload = memoryview(np.ascontiguousarray(world)).cast("B")
     raw = json.dumps(header).encode()
-    sock.sendall(_LEN.pack(len(raw)) + raw)
-    if payload is not None:
-        sock.sendall(payload)
-    sent = 4 + len(raw) + (payload.nbytes if payload is not None else 0)
-    obs.WIRE_BYTES.labels(direction="sent").inc(sent)
+    frame = memoryview(_LEN.pack(len(raw)) + raw)
+    sent = 0
+    try:
+        # send() loops instead of sendall() so a connection that dies
+        # mid-payload still tells us how many bytes made it out.
+        while sent < len(frame):
+            sent += sock.send(frame[sent:])
+        if payload is not None:
+            off = 0
+            while off < payload.nbytes:
+                n = sock.send(payload[off:])
+                off += n
+                sent += n
+    finally:
+        if sent:
+            obs.WIRE_BYTES.labels(direction="sent").inc(sent)
     obs.WIRE_MESSAGES.labels(direction="sent").inc()
     return sent
 
 
 def recv_msg(sock: socket.socket) -> Tuple[dict, Optional[np.ndarray]]:
-    (n,) = _LEN.unpack(_recv_exact(sock, 4))
-    if n > MAX_HEADER:
-        raise ConnectionError(f"header too large: {n}")
-    raw = _recv_exact(sock, n)
+    tally = _Tally()
     try:
-        header = json.loads(raw)
-    except ValueError as e:  # bad UTF-8 or bad JSON — peer is garbage
-        raise ConnectionError(f"malformed header: {e}") from e
-    if not isinstance(header, dict):
-        raise ConnectionError(
-            f"malformed header: expected object, got {type(header).__name__}")
-    world = None
-    if "world" in header and header["world"] is not None:
+        (n,) = _LEN.unpack(_recv_exact(sock, 4, tally))
+        if n > MAX_HEADER:
+            raise ConnectionError(f"header too large: {n}")
+        raw = _recv_exact(sock, n, tally)
         try:
-            h = int(header["world"]["h"])
-            w = int(header["world"]["w"])
-        except (TypeError, KeyError, ValueError) as e:
-            raise ConnectionError(f"malformed world dims: {e}") from e
-        if h <= 0 or w <= 0 or h * w > max_board_cells():
-            raise ConnectionError(f"board dims out of bounds: {h}x{w}")
-        # Receive straight into the final array — going through bytes
-        # would peak at ~3x the payload for a multi-GB snapshot.
-        world = np.empty((h, w), dtype=np.uint8)
-        mv = memoryview(world).cast("B")
-        got = 0
-        while got < h * w:
-            n_read = sock.recv_into(mv[got:])
-            if n_read == 0:
-                raise ConnectionError("peer closed mid-message")
-            got += n_read
-    obs.WIRE_BYTES.labels(direction="received").inc(
-        4 + n + (world.nbytes if world is not None else 0))
+            header = json.loads(raw)
+        except ValueError as e:  # bad UTF-8 or bad JSON — peer is garbage
+            raise ConnectionError(f"malformed header: {e}") from e
+        if not isinstance(header, dict):
+            raise ConnectionError(
+                f"malformed header: expected object, "
+                f"got {type(header).__name__}")
+        world = None
+        if "world" in header and header["world"] is not None:
+            try:
+                h = int(header["world"]["h"])
+                w = int(header["world"]["w"])
+            except (TypeError, KeyError, ValueError) as e:
+                raise ConnectionError(f"malformed world dims: {e}") from e
+            if h <= 0 or w <= 0 or h * w > max_board_cells():
+                raise ConnectionError(f"board dims out of bounds: {h}x{w}")
+            # Receive straight into the final array — going through bytes
+            # would peak at ~3x the payload for a multi-GB snapshot.
+            world = np.empty((h, w), dtype=np.uint8)
+            mv = memoryview(world).cast("B")
+            got = 0
+            while got < h * w:
+                n_read = sock.recv_into(mv[got:])
+                if n_read == 0:
+                    raise ConnectionError("peer closed mid-message")
+                got += n_read
+                tally.n += n_read
+    finally:
+        if tally.n:
+            obs.WIRE_BYTES.labels(direction="received").inc(tally.n)
     obs.WIRE_MESSAGES.labels(direction="received").inc()
     return header, world

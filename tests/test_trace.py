@@ -1,0 +1,521 @@
+"""Distributed tracing + flight recorder (gol_tpu/obs/trace.py,
+gol_tpu/obs/flight.py): span recorder semantics, Chrome trace-event
+export, wire propagation of the compact "tc" context (client span id
+arrives as server parent id — over a raw socketpair AND through a real
+EngineServer), flight-recorder dumps on watchdog fire / SIGTERM /
+engine-loop exception, the finally-metered wire byte counters, the
+/healthz + /metrics.json endpoints, and the catalog naming contract."""
+
+import json
+import os
+import re
+import signal
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gol_tpu.obs import catalog
+from gol_tpu.obs import flight
+from gol_tpu.obs import trace
+from gol_tpu.obs.metrics import REGISTRY
+from gol_tpu.params import Params
+from gol_tpu.wire import recv_msg, send_msg
+
+from server_harness import spawn_server, wait_port
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Each test reads only its own spans from the shared tracer. A span
+    left on THIS thread's context stack by earlier tests would silently
+    reparent everything here (and make send_msg inject its context), so
+    drain it too — an unexpectedly non-empty stack is itself a bug."""
+    leaked = []
+    while trace.current() is not None:
+        leaked.append(trace.current().name)
+        trace.TRACER.pop(trace.current())
+    assert not leaked, f"earlier test leaked open span(s): {leaked}"
+    trace.TRACER.reset()
+    yield
+    trace.TRACER.reset()
+
+
+def _spans_by_name():
+    by = {}
+    for rec in trace.TRACER.finished_spans():
+        by.setdefault(rec["name"], []).append(rec)
+    return by
+
+
+# ------------------------------------------------------------- span core
+
+
+def test_span_ids_parenting_and_context_stack():
+    root = trace.start("t.root")
+    assert root.parent_id is None
+    assert re.fullmatch(r"[0-9a-f]{16}", root.trace_id)
+    assert re.fullmatch(r"[0-9a-f]{16}", root.span_id)
+    trace.TRACER.push(root)
+    try:
+        with trace.span("t.child") as child:
+            # inherits the innermost open span on this thread
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            with trace.span("t.grandchild") as gc:
+                assert gc.parent_id == child.span_id
+    finally:
+        trace.TRACER.pop(root)
+        trace.finish(root)
+    by = _spans_by_name()
+    mine = {n for n in by if n.startswith("t.")}
+    assert mine == {"t.root", "t.child", "t.grandchild"}
+    # finish is idempotent — recovery paths may double-finish
+    trace.finish(root)
+    assert len(_spans_by_name()["t.root"]) == 1
+
+
+def test_span_buffer_bounded_with_drop_counter():
+    t = trace.Tracer(cap=4)
+    before = catalog.TRACE_SPAN_DROPS_TOTAL.value
+    for i in range(7):
+        t.finish(t.start(f"t.s{i}"))
+    assert len(t.finished_spans()) == 4
+    assert t.dropped() == 3
+    assert catalog.TRACE_SPAN_DROPS_TOTAL.value == before + 3
+
+
+def test_parse_context_rejects_garbage():
+    good = {"t": "a" * 16, "s": "b" * 16}
+    assert trace.parse_context(good) == good
+    for bad in (None, 7, "x", [], {}, {"t": "a" * 16},
+                {"t": "A" * 16, "s": "b" * 16},       # uppercase
+                {"t": "a" * 15, "s": "b" * 16},       # short
+                {"t": "a" * 16, "s": 12345},
+                {"t": "g" * 16, "s": "b" * 16}):      # non-hex
+        assert trace.parse_context(bad) is None, bad
+    # a garbage parent makes a fresh root instead of raising
+    s = trace.start("t.x", parent={"t": "junk", "s": "junk"})
+    assert s.parent_id is None
+
+
+def test_error_recorded_on_span():
+    with pytest.raises(ValueError):
+        with trace.span("t.fail"):
+            raise ValueError("boom")
+    rec = _spans_by_name()["t.fail"][0]
+    assert rec["attrs"]["error"] == "ValueError: boom"
+
+
+# ---------------------------------------------------------- chrome export
+
+
+def test_chrome_export_shape_and_open_spans(tmp_path):
+    trace.finish(trace.start("t.done", attrs={"k": 3}))
+    still_open = trace.start("t.open")  # never finished: must export as B
+    path = trace.TRACER.export_chrome(str(tmp_path / "spans.json"))
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    trace.validate_chrome(doc)  # raises on structural problems
+    assert doc["displayTimeUnit"] == "ms"
+    phases = {ev["name"]: ev["ph"] for ev in doc["traceEvents"]}
+    assert phases["t.done"] == "X"
+    assert phases["t.open"] == "B"
+    assert phases["process_name"] == "M"
+    assert phases["thread_name"] == "M"
+    done = next(ev for ev in doc["traceEvents"] if ev["name"] == "t.done")
+    assert done["cat"] == "t"
+    assert done["args"]["k"] == 3
+    # ts is wall-clock microseconds (epoch-shifted monotonic)
+    assert abs(done["ts"] / 1e6 - time.time()) < 300
+    trace.finish(still_open)
+
+
+def test_export_chrome_directory_gets_per_pid_file(tmp_path):
+    trace.finish(trace.start("t.a"))
+    path = trace.TRACER.export_chrome(str(tmp_path))
+    assert path == str(tmp_path / f"gol-spans-{os.getpid()}.json")
+    assert os.path.exists(path)
+
+
+def test_export_from_env(tmp_path, monkeypatch):
+    trace.finish(trace.start("t.env"))
+    assert trace.export_from_env() is None  # unset → no-op
+    target = tmp_path / "via_env.json"
+    monkeypatch.setenv(trace.TRACE_SPANS_ENV, str(target))
+    assert trace.export_from_env() == str(target)
+    trace.validate_chrome(json.load(open(target)))
+
+
+# ------------------------------------------------- wire propagation (tc)
+
+
+def test_tc_propagates_over_socketpair():
+    """The ISSUE contract: the client's span id arrives at the server as
+    the parent id of the handler span — over a real socketpair."""
+    a, b = socket.socketpair()
+    try:
+        with trace.span("rpc.Ping") as client_span:
+            send_msg(a, {"method": "Ping"})
+        header, _ = recv_msg(b)
+        assert header["tc"] == {"t": client_span.trace_id,
+                                "s": client_span.span_id}
+        with trace.span("serve.Ping", parent=header.get("tc")) as srv:
+            assert srv.trace_id == client_span.trace_id
+            assert srv.parent_id == client_span.span_id
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tc_not_injected_without_span_and_not_overwritten():
+    a, b = socket.socketpair()
+    try:
+        send_msg(a, {"method": "Ping"})  # no open span on this thread
+        header, _ = recv_msg(b)
+        assert "tc" not in header
+        explicit = {"t": "c" * 16, "s": "d" * 16}
+        with trace.span("rpc.Ping"):
+            send_msg(a, {"method": "Ping", "tc": explicit})
+        header, _ = recv_msg(b)
+        assert header["tc"] == explicit  # explicit context wins
+    finally:
+        a.close()
+        b.close()
+
+
+def test_client_server_span_propagation_real_server():
+    """Through the real dispatch path: RemoteEngine.ping() against an
+    in-process EngineServer — serve.Ping must parent under rpc.Ping."""
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import Engine
+    from gol_tpu.server import EngineServer
+
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    try:
+        RemoteEngine(f"127.0.0.1:{srv.port}").ping()
+    finally:
+        srv.shutdown()
+    deadline = time.monotonic() + 5
+    while ("serve.Ping" not in _spans_by_name()
+           and time.monotonic() < deadline):
+        time.sleep(0.01)  # conn thread may still be finishing its span
+    by = _spans_by_name()
+    rpc = by["rpc.Ping"][0]
+    serve = by["serve.Ping"][0]
+    assert serve["trace"] == rpc["trace"]
+    assert serve["parent"] == rpc["span"]
+
+
+# --------------------------------------------- wire byte metering (finally)
+
+
+def test_recv_partial_transfer_metered():
+    a, b = socket.socketpair()
+    try:
+        hdr = {"ok": True, "world": {"h": 64, "w": 64}}
+        raw = json.dumps(hdr).encode()
+        a.sendall(struct.pack(">I", len(raw)) + raw)
+        a.sendall(b"\0" * 1000)  # 1000 of the promised 4096 payload bytes
+        a.close()
+        before_b = catalog.WIRE_BYTES.labels(direction="received").value
+        before_m = catalog.WIRE_MESSAGES.labels(direction="received").value
+        with pytest.raises(ConnectionError):
+            recv_msg(b)
+        got = catalog.WIRE_BYTES.labels(
+            direction="received").value - before_b
+        # the partial transfer is still counted, the message is not
+        assert got == 4 + len(raw) + 1000
+        assert catalog.WIRE_MESSAGES.labels(
+            direction="received").value == before_m
+    finally:
+        b.close()
+
+
+def test_send_partial_transfer_metered():
+    a, b = socket.socketpair()
+    drained = threading.Event()
+
+    def drain_some_then_close():
+        got = 0
+        while got < 65536:
+            chunk = b.recv(4096)
+            if not chunk:
+                break
+            got += len(chunk)
+        b.close()
+        drained.set()
+
+    t = threading.Thread(target=drain_some_then_close, daemon=True)
+    t.start()
+    world = np.zeros((4096, 4096), dtype=np.uint8)  # 16 MiB payload
+    before_b = catalog.WIRE_BYTES.labels(direction="sent").value
+    before_m = catalog.WIRE_MESSAGES.labels(direction="sent").value
+    try:
+        with pytest.raises(OSError):
+            send_msg(a, {"method": "GetWorld"}, world)
+        drained.wait(5)
+        sent = catalog.WIRE_BYTES.labels(direction="sent").value - before_b
+        assert 0 < sent < world.nbytes  # partial, but counted
+        assert catalog.WIRE_MESSAGES.labels(
+            direction="sent").value == before_m
+    finally:
+        a.close()
+        t.join(5)
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_flight_ring_bounded_and_snapshot_valid():
+    fr = flight.FlightRecorder(cap=4)
+    for i in range(9):
+        fr.record_event({"i": i})
+        fr.record_span({"name": f"s{i}"})
+    doc = fr.snapshot("manual")
+    flight.validate_dump(doc)
+    assert [e["i"] for e in doc["events"]] == [5, 6, 7, 8]
+    assert len(doc["spans"]) == 4
+    assert doc["run_id"] == flight.RUN_ID
+
+
+def test_log_events_feed_flight_ring():
+    from gol_tpu.obs.log import log
+
+    marker = f"trace-test-{os.getpid()}-{time.monotonic_ns()}"
+    log("test.marker", detail=marker)
+    events = flight.FLIGHT.snapshot("manual")["events"]
+    assert any(e.get("event") == "test.marker"
+               and e.get("detail") == marker for e in events)
+
+
+def test_flight_dump_disabled_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(flight.FLIGHT_ENV, raising=False)
+    assert flight.FLIGHT.dump("manual") is None
+
+
+def test_flight_dump_to_dir_and_reason_counter(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_ENV, str(tmp_path))
+    before = catalog.FLIGHT_DUMPS_TOTAL.labels(reason="manual").value
+    path = flight.FLIGHT.dump("manual")
+    assert path == str(tmp_path / f"gol-flight-{os.getpid()}-manual.json")
+    flight.validate_dump(json.load(open(path)))
+    assert catalog.FLIGHT_DUMPS_TOTAL.labels(
+        reason="manual").value == before + 1
+
+
+def test_flight_dump_contains_open_spans(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_ENV, str(tmp_path / "f.json"))
+    s = trace.start("t.inflight")
+    try:
+        doc = json.load(open(flight.FLIGHT.dump("manual")))
+        assert any(o["name"] == "t.inflight" and o["end"] is None
+                   for o in doc["open_spans"])
+    finally:
+        trace.finish(s)
+
+
+def test_engine_loop_exception_dumps_flight(tmp_path, monkeypatch):
+    """An unhandled chunk-loop error writes a reason="exception" dump
+    (and still propagates to the caller)."""
+    import gol_tpu.engine as engine_mod
+
+    dump = tmp_path / "crash.json"
+    monkeypatch.setenv(flight.FLIGHT_ENV, str(dump))
+
+    def explode(chunk, remaining):
+        raise RuntimeError("chunk loop boom")
+
+    monkeypatch.setattr(engine_mod, "_next_chunk", explode)
+    eng = engine_mod.Engine()
+    world = np.zeros((64, 64), dtype=np.uint8)
+    p = Params(threads=1, image_width=64, image_height=64, turns=8)
+    with pytest.raises(RuntimeError, match="chunk loop boom"):
+        eng.server_distributor(p, world)
+    doc = json.load(open(dump))
+    flight.validate_dump(doc)
+    assert doc["reason"] == "exception"
+    assert any(e.get("event") == "engine.run_loop"
+               and "chunk loop boom" in e.get("error", "")
+               for e in doc["events"])
+
+
+def test_watchdog_fire_dumps_flight_with_inflight_span(
+        tmp_path, monkeypatch):
+    """Simulated watchdog fire: the engine vanishes mid-run, the
+    heartbeat watchdog declares it lost, and the dump written at that
+    instant carries the still-open rpc.ServerDistributor span."""
+    from gol_tpu.client import RemoteEngine
+
+    monkeypatch.setenv(flight.FLIGHT_ENV, str(tmp_path))
+    monkeypatch.setenv("GOL_HB_INTERVAL", "0.05")
+    monkeypatch.setenv("GOL_HB_MISSES", "2")
+
+    # A "server" that accepts the run connection and then goes silent;
+    # once the listener closes, heartbeat pings get connection-refused.
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    port = lst.getsockname()[1]
+    eng = RemoteEngine(f"127.0.0.1:{port}", timeout=1.0)
+    p = Params(threads=1, image_width=8, image_height=8, turns=10)
+    world = np.zeros((8, 8), dtype=np.uint8)
+    result = {}
+
+    def run():
+        try:
+            eng.server_distributor(p, world)
+        except Exception as e:
+            result["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    conn, _ = lst.accept()   # the run socket (opened before the probes)
+    lst.close()              # probes now fail fast
+    t.join(20)
+    conn.close()
+    assert not t.is_alive()
+    assert "heartbeat lost" in str(result["error"])
+    path = tmp_path / f"gol-flight-{os.getpid()}-watchdog.json"
+    doc = json.load(open(path))
+    flight.validate_dump(doc)
+    assert doc["reason"] == "watchdog"
+    assert any(o["name"] == "rpc.ServerDistributor"
+               for o in doc["open_spans"])
+    assert any(e.get("event") == "client.heartbeat_lost"
+               for e in doc["events"])
+
+
+@pytest.mark.timeout(300)
+def test_sigterm_mid_run_dumps_inflight_spans(tmp_path, monkeypatch):
+    """Acceptance: killing the server mid-run produces a flight dump
+    whose open spans include the in-flight handler/engine spans, joined
+    to THIS controller's trace id."""
+    from gol_tpu.client import RemoteEngine
+
+    flight_dir = tmp_path / "flight"
+    flight_dir.mkdir()
+    proc = spawn_server(0, tmp_path,
+                        extra_env={"GOL_FLIGHT": str(flight_dir)})
+    try:
+        port = wait_port(proc)
+        assert port, "server never announced its port"
+        eng = RemoteEngine(f"127.0.0.1:{port}")
+        world = np.zeros((64, 64), dtype=np.uint8)
+        world[20:23, 20] = 255
+        p = Params(threads=1, image_width=64, image_height=64,
+                   turns=10_000_000)
+        result = {}
+
+        def run():
+            try:
+                eng.server_distributor(p, world)
+            except Exception as e:
+                result["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if eng.ping() > 0:
+                    break  # the run is genuinely in flight
+            except (ConnectionError, OSError):
+                pass
+            time.sleep(0.2)
+        else:
+            pytest.fail("run never started making turns")
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(30) is not None
+        t.join(30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(10)
+    dumps = list(flight_dir.glob("gol-flight-*-sigterm.json"))
+    assert dumps, f"no sigterm dump in {flight_dir}"
+    doc = json.load(open(dumps[0]))
+    flight.validate_dump(doc)
+    assert doc["reason"] == "sigterm"
+    open_names = {o["name"] for o in doc["open_spans"]}
+    assert "serve.ServerDistributor" in open_names
+    assert "engine.run" in open_names
+    # Cross-process join: the server-side handler span carries the
+    # trace id minted by THIS process's rpc.ServerDistributor span.
+    rpc = _spans_by_name()["rpc.ServerDistributor"][0]
+    serve = next(o for o in doc["open_spans"]
+                 if o["name"] == "serve.ServerDistributor")
+    assert serve["trace"] == rpc["trace"]
+    assert serve["parent"] == rpc["span"]
+
+
+def test_distributor_startup_failure_unwinds_run_span(monkeypatch):
+    """Regression: a startup failure (malformed GOL_RULE) before the
+    engine exists must pop+finish the already-pushed controller.run
+    span — a leak here leaves a dead span on the caller's context stack,
+    and every later send_msg from that thread inherits its context."""
+    import queue
+
+    from gol_tpu.distributor import distributor
+
+    monkeypatch.setenv("GOL_RULE", "not-a-rule")
+    monkeypatch.delenv("SER", raising=False)
+    q = queue.Queue()
+    with pytest.raises(ValueError):
+        distributor(Params(threads=1, image_width=16, image_height=16,
+                           turns=1), q, None)
+    assert trace.current() is None
+    rec = _spans_by_name()["controller.run"][0]
+    assert rec["end"] is not None
+    assert rec["attrs"]["error"].startswith("ValueError")
+
+
+# ------------------------------------------------------- http endpoints
+
+
+def test_healthz_and_metrics_json_endpoints():
+    from gol_tpu.obs.http import start_metrics_server
+
+    srv = start_metrics_server(0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        health = json.loads(urllib.request.urlopen(
+            f"{base}/healthz", timeout=10).read().decode())
+        assert health["run_id"] == flight.RUN_ID
+        assert isinstance(health["turn"], (int, float))
+        assert health["uptime_s"] >= 0
+        snap = json.loads(urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=10).read().decode())
+        assert snap == REGISTRY.snapshot() or set(snap) == set(
+            REGISTRY.snapshot())  # counters may tick between reads
+        assert "gol_engine_turn" in snap
+        assert "gol_trace_spans_total" in snap
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------- catalog naming
+
+
+def test_catalog_names_match_prometheus_regex():
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    fams = REGISTRY.families()
+    assert fams, "registry unexpectedly empty"
+    for name in fams:
+        assert name_re.match(name), name
+        assert name.startswith("gol_"), name
+
+
+def test_flight_reason_label_clamped():
+    assert catalog.flight_reason_label("watchdog") == "watchdog"
+    assert catalog.flight_reason_label("totally-new") == "unknown"
+    # pre-seeded at zero for dashboards
+    snap = REGISTRY.snapshot()["gol_flight_dumps_total"]
+    seeded = {v["labels"]["reason"] for v in snap["values"]}
+    assert {"sigterm", "watchdog", "exception",
+            "manual", "unknown"} <= seeded

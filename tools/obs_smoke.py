@@ -1,13 +1,18 @@
 """obs-smoke: prove the observability plumbing end to end on CPU.
 
-Runs a tiny board through the real CLI with `--run-report` and
-`--metrics-port 0`, then validates BOTH outputs:
+Runs a tiny board through the real CLI with `--run-report`,
+`--metrics-port 0`, and `--trace-spans`, then validates ALL outputs:
 
   * the run report parses as schema gol-run-report/1 and contains at
     least one chunk record with wall/turns/CUPS populated, bracketed by
     run_start/run_end;
   * the `/metrics` endpoint serves parseable Prometheus text including
-    the engine turn/CUPS gauges and the wire/server counter families.
+    the engine turn/CUPS gauges and the wire/server counter families;
+  * the span export is a valid Chrome trace-event document whose
+    controller.run / engine.run / engine.chunk spans share one trace id
+    with correct parent links;
+  * every metric family in the registry matches the Prometheus naming
+    regex and carries the gol_ prefix.
 
 Runs IN-PROCESS (main() is called, not subprocessed) so the ephemeral
 metrics port is discoverable without output scraping, and stays inside
@@ -18,7 +23,9 @@ the tier-1 time budget. Exit 0 = pass.
 
 from __future__ import annotations
 
+import json
 import os
+import re
 import sys
 import tempfile
 import urllib.request
@@ -31,15 +38,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
 def main() -> int:
-    report = os.path.join(
-        tempfile.mkdtemp(prefix="gol_obs_smoke_"), "run.jsonl")
+    tmpdir = tempfile.mkdtemp(prefix="gol_obs_smoke_")
+    report = os.path.join(tmpdir, "run.jsonl")
+    spans_path = os.path.join(tmpdir, "spans.json")
 
     from gol_tpu.main import main as gol_main
 
     rc = gol_main(["-w", "64", "-h", "64", "--turns", "64",
                    "--rle", "rpentomino", "--headless", "-t", "1",
-                   "--run-report", report, "--metrics-port", "0"])
+                   "--run-report", report, "--metrics-port", "0",
+                   "--trace-spans", spans_path])
     if rc != 0:
         print(f"obs-smoke: CLI run failed rc={rc}", file=sys.stderr)
         return 1
@@ -85,12 +97,59 @@ def main() -> int:
             problems.append("no gol_engine_turn sample")
         srv.close()
 
+    # ---- span export ---------------------------------------------------
+    from gol_tpu.obs import trace
+
+    n_span_events = 0
+    if not os.path.exists(spans_path):
+        problems.append("span export was not written")
+    else:
+        try:
+            with open(spans_path, encoding="utf-8") as f:
+                doc = json.load(f)
+            trace.validate_chrome(doc)
+            by_name = {}
+            for evd in doc["traceEvents"]:
+                if evd["ph"] in ("X", "B"):
+                    n_span_events += 1
+                    by_name.setdefault(evd["name"], []).append(evd["args"])
+            for needed in ("controller.run", "engine.run", "engine.chunk"):
+                if needed not in by_name:
+                    problems.append(f"span export missing {needed!r}")
+            if not problems:
+                ctrl = by_name["controller.run"][0]
+                erun = by_name["engine.run"][0]
+                if erun["trace_id"] != ctrl["trace_id"] \
+                        or erun.get("parent_id") != ctrl["span_id"]:
+                    problems.append("engine.run not parented under "
+                                    "controller.run")
+                for ch in by_name["engine.chunk"]:
+                    if ch["trace_id"] != ctrl["trace_id"] \
+                            or ch.get("parent_id") != erun["span_id"]:
+                        problems.append("engine.chunk not parented "
+                                        "under engine.run")
+                        break
+        except (ValueError, KeyError) as e:
+            problems.append(f"span export invalid: {e}")
+
+    # ---- catalog naming ------------------------------------------------
+    from gol_tpu.obs.metrics import REGISTRY
+
+    for name in REGISTRY.families():
+        if not PROM_NAME_RE.match(name):
+            problems.append(f"metric name violates Prometheus regex: "
+                            f"{name!r}")
+        if not name.startswith("gol_"):
+            problems.append(f"metric name missing gol_ prefix: {name!r}")
+
     if problems:
         for p in problems:
             print(f"obs-smoke: FAIL: {p}", file=sys.stderr)
         return 1
     print(f"obs-smoke: OK — {len(chunks)} chunk record(s), "
-          f"/metrics served {len(body)} bytes")
+          f"/metrics served {len(body)} bytes, "
+          f"{n_span_events} span event(s), "
+          f"{len(REGISTRY.families())} metric families named cleanly")
     return 0
 
 
