@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,6 +13,12 @@ bench:
 # the Prometheus /metrics output (tools/obs_smoke.py).
 obs-smoke:
 	JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
+# End-to-end checkpoint/restore check, CPU-only: SIGKILL a real server
+# mid-run, --resume a replacement bit-identically, refuse corrupted
+# payloads, prove retention safety (tools/ckpt_smoke.py).
+ckpt-smoke:
+	JAX_PLATFORMS=cpu python tools/ckpt_smoke.py
 
 native:
 	$(MAKE) -C csrc

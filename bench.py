@@ -543,7 +543,8 @@ def bench_ksweep(n: int) -> int:
 ENGINE_TURNS = 60_000_000
 
 
-def bench_engine(turns: int = ENGINE_TURNS) -> int:
+def bench_engine(turns: int = ENGINE_TURNS, ckpt_dir: str = "",
+                 ckpt_every: int = 0) -> int:
     """Sustained throughput of the FULL engine stack (adaptive chunk
     pipeline, flag handshakes, state publication) on the 512² fixture —
     the interactive-run number, as opposed to the raw-kernel legs.
@@ -566,8 +567,16 @@ def bench_engine(turns: int = ENGINE_TURNS) -> int:
     # the engine-behavior knobs; the compile cache stays.
     for var in ("GOL_MAX_CHUNK", "GOL_CHUNK_TARGET", "GOL_PIPELINE_DEPTH",
                 "GOL_PIPELINE_BUDGET", "GOL_MESH", "GOL_CKPT",
-                "GOL_CKPT_EVERY", "GOL_TRACE", "GOL_RULE"):
+                "GOL_CKPT_EVERY", "GOL_CKPT_EVERY_TURNS", "GOL_CKPT_KEEP",
+                "GOL_CKPT_KEEP_EVERY", "GOL_TRACE", "GOL_RULE"):
         os.environ.pop(var, None)
+    if ckpt_dir and ckpt_every > 0:
+        # Opt-in checkpoint overhead measurement: the async writer runs
+        # at the requested turn cadence during the TIMED run, so the
+        # turns/s delta vs a plain `--engine` run IS the hot-loop cost
+        # of checkpointing (acceptance: <5%).
+        os.environ["GOL_CKPT"] = ckpt_dir
+        os.environ["GOL_CKPT_EVERY_TURNS"] = str(ckpt_every)
 
     try:
         world = read_pgm("images/512x512.pgm")
@@ -600,11 +609,29 @@ def bench_engine(turns: int = ENGINE_TURNS) -> int:
         how = f"period-2 ash count at turn {turns} (want {want})"
     else:
         parity, how = None, "no gate below the ash-settling horizon"
+    detail = {"turns": turns, "elapsed_s": round(elapsed, 4),
+              "alive": alive, "alive_parity": parity, "parity_check": how}
+    if ckpt_dir and ckpt_every > 0:
+        # Surface what the async writer actually did during the timed
+        # run — "dropped" counts snapshots superseded by a newer one
+        # while a write was in flight (the double-buffer working as
+        # designed, not data loss: the newest state always lands).
+        from gol_tpu.obs import catalog as obs_cat
+
+        detail["ckpt"] = {
+            "every_turns": ckpt_every,
+            "writes_ok": obs_cat.CKPT_WRITES.labels(status="ok").value,
+            "writes_error":
+                obs_cat.CKPT_WRITES.labels(status="error").value,
+            "writes_dropped":
+                obs_cat.CKPT_WRITES.labels(status="dropped").value,
+            "bytes": obs_cat.CKPT_BYTES.value,
+            "last_turn": obs_cat.CKPT_LAST_TURN.value,
+        }
     _emit(
         "turns/sec (512x512, full engine stack)",
         round(turns / elapsed, 1), "turns/s", None,
-        {"turns": turns, "elapsed_s": round(elapsed, 4),
-         "alive": alive, "alive_parity": parity, "parity_check": how},
+        detail,
     )
     if parity is False:
         print(f"PARITY FAIL (engine): turn={turn} alive={alive}",
@@ -631,6 +658,13 @@ def main() -> int:
     ap.add_argument("--engine", action="store_true",
                     help="run the full-engine-stack 512² sustained leg "
                          "only (adaptive chunk pipeline + control plane)")
+    ap.add_argument("--ckpt-dir", default="", metavar="DIR",
+                    help="with --engine: checkpoint into DIR during the "
+                         "timed run (measures the async writer's "
+                         "hot-loop overhead; needs --ckpt-every)")
+    ap.add_argument("--ckpt-every", type=int, default=0, metavar="TURNS",
+                    help="with --engine --ckpt-dir: checkpoint cadence "
+                         "in turns")
     ap.add_argument("--gen", action="store_true",
                     help="run the Generations-family leg (Brian's Brain "
                          "bit-plane kernel; combine with --size/--turns)")
@@ -691,7 +725,10 @@ def main() -> int:
             ap.error("--engine is its own config; combine only with "
                      "--turns")
         turns = args.turns if args.turns is not None else ENGINE_TURNS
-        return bench_engine(turns)
+        return bench_engine(turns, ckpt_dir=args.ckpt_dir,
+                            ckpt_every=args.ckpt_every)
+    if args.ckpt_dir or args.ckpt_every:
+        ap.error("--ckpt-dir/--ckpt-every apply to the --engine leg only")
 
     if args.gen:
         if args.pattern != "dense":

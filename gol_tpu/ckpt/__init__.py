@@ -1,0 +1,55 @@
+"""Checkpoint/restore subsystem: async snapshots, integrity-verified
+manifests, resumable and crash-recoverable runs.
+
+The reference's only continuity mechanism is controller detach/reattach
+(`CONT=yes`, `Local/gol/distributor.go:171-178`) — state lives in broker
+globals and dies with the process. This package adds the durability
+layer underneath: every checkpoint is a payload `.npz` (the exact format
+`Engine.load_checkpoint` already speaks) plus a `gol-ckpt/1` JSON
+manifest recording run identity, turn, rule, board geometry,
+representation, and the SHA-256 of the payload, published
+payload-first / manifest-last with tmp+fsync+rename at each step so a
+crash at ANY instant leaves either a durable checkpoint or removable
+garbage — never a torn file a resume could silently trust.
+
+Layout of a checkpoint directory (GOL_CKPT / --checkpoint):
+
+    ckpt-000000001024.npz    payload (published first)
+    ckpt-000000001024.json   manifest (published second — durability bit)
+
+Modules:
+
+    manifest.py   schema, atomic write/read/verify, directory listing
+    writer.py     background double-buffered writer (off the hot loop)
+    retention.py  keep-last + keep-every-K-turns GC, crash-safe
+    restore.py    resolve dir|manifest|legacy-npz -> verified engine state
+
+Env / flags (read at run time, like every GOL_* knob):
+
+    GOL_CKPT=<dir>                --checkpoint DIR    checkpoint directory
+    GOL_CKPT_EVERY_TURNS=<n>      --ckpt-every N      manifest ckpt cadence
+    GOL_CKPT_KEEP=<n>             --ckpt-keep N       retention: keep last N
+    GOL_CKPT_KEEP_EVERY=<turns>                       retention: pin every K
+    GOL_CKPT_EVERY=<seconds>                          legacy single-file autosave
+
+docs/ARCHITECTURE.md "Checkpoint / restore" is the narrative version.
+"""
+
+from gol_tpu.ckpt.manifest import (  # noqa: F401
+    CheckpointIntegrityError,
+    MANIFEST_SCHEMA,
+    list_checkpoints,
+    read_manifest,
+    verify_manifest,
+    write_manifest,
+)
+from gol_tpu.ckpt.restore import resolve, restore_engine  # noqa: F401
+from gol_tpu.ckpt.retention import RetentionPolicy  # noqa: F401
+from gol_tpu.ckpt.writer import CheckpointWriter, Snapshot  # noqa: F401
+
+# Env names (single source; engine/server/main/bench all import these).
+CKPT_DIR_ENV = "GOL_CKPT"
+CKPT_EVERY_TURNS_ENV = "GOL_CKPT_EVERY_TURNS"
+CKPT_KEEP_ENV = "GOL_CKPT_KEEP"
+CKPT_KEEP_EVERY_ENV = "GOL_CKPT_KEEP_EVERY"
+CKPT_KEEP_DEFAULT = 3

@@ -1,0 +1,300 @@
+"""Background double-buffered checkpoint writer.
+
+The turn loop must never block on disk (the < 5 % CUPS budget in
+ISSUE/acceptance): at a chunk boundary the engine captures a Snapshot —
+the IMMUTABLE device array handle plus metadata, a lock-held pointer
+copy — and `submit()`s it. The writer thread then does everything
+expensive off the hot loop: the device→host transfer (jax arrays are
+immutable, so reading the handle races nothing; the engine meanwhile
+dispatches the next chunks against newer handles), payload
+serialization, SHA-256, the payload-first/manifest-last atomic publish,
+and retention GC.
+
+Double buffering: one snapshot in write + at most one pending. A third
+submit before the disk catches up REPLACES the pending snapshot (newest
+state wins — a checkpoint's only job is to be the freshest durable
+state) and the superseded one is counted as
+`gol_ckpt_writes_total{status="dropped"}` rather than queued: an
+unbounded queue would turn a slow disk into unbounded host memory.
+
+`write_sync()` is the same pipeline on the CALLING thread — the
+emergency paths (SIGTERM, engine-loop exception, the Checkpoint wire
+method) where there may be no later boundary to wait for.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from gol_tpu.ckpt import manifest as mf
+from gol_tpu.ckpt.retention import RetentionPolicy, dir_lock
+from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import trace as obs_trace
+from gol_tpu.obs.log import log as obs_log
+
+# Same compression policy as the legacy engine autosave
+# (engine.Engine.CKPT_COMPRESS_LIMIT): small payloads are
+# zlib-compressed, huge ones written raw — compressing a 512 MB packed
+# flagship board would dominate the checkpoint interval for little gain.
+COMPRESS_LIMIT = 64 * 1024 * 1024
+
+# Manifest trigger values (clamped — manifests are machine-read).
+TRIGGERS = ("periodic", "final", "emergency", "sigterm", "manual",
+            "remote")
+
+# 8-bit popcount LUT for the packed-word alive marker (uint8 output is
+# enough per byte; the sum accumulates in int64).
+_POP8 = np.array([bin(i).count("1") for i in range(256)], dtype=np.uint8)
+
+
+class Snapshot:
+    """One checkpointable engine state, captured at a chunk boundary.
+
+    `cells` is either a jax array handle (dense/sparse device state —
+    the writer thread materializes it) or an already-host numpy array
+    (the restore/inspect round-trip tests). `pad` rows are
+    representation, not board, and are cropped host-side. `extra`
+    carries the sparse window's (size, ox, oy)."""
+
+    __slots__ = ("cells", "repr", "pad", "turn", "board", "rule",
+                 "trigger", "extra")
+
+    def __init__(self, cells, repr_: str, pad: int, turn: int,
+                 board: Tuple[int, int], rule: str,
+                 trigger: str = "periodic", extra: Optional[dict] = None):
+        self.cells = cells
+        self.repr = repr_
+        self.pad = pad
+        self.turn = turn
+        self.board = board
+        self.rule = rule
+        self.trigger = trigger if trigger in TRIGGERS else "manual"
+        self.extra = dict(extra or {})
+
+
+def _materialize(snap: Snapshot) -> np.ndarray:
+    """Device handle (or host array) -> host array, pad rows cropped.
+    Blocks until the handle is real — on the WRITER thread, where that
+    wait overlaps the engine's next chunks instead of stalling them."""
+    import jax
+
+    host = np.asarray(jax.device_get(snap.cells))
+    if snap.pad:
+        host = host[..., : host.shape[-2] - snap.pad, :]
+    return host
+
+
+def payload_arrays(host: np.ndarray, repr_: str, extra: dict) -> dict:
+    """The payload .npz members for one representation — EXACTLY the
+    format `Engine.load_checkpoint` / `SparseEngine.load_checkpoint`
+    already accept, so every manifest payload doubles as a legacy
+    checkpoint file."""
+    if repr_ == "packed":
+        return {"words": host, "width": host.shape[-1] * 32}
+    if repr_ == "gen3":
+        return {"gen_planes": host, "width": host.shape[-1] * 32}
+    if repr_ == "gen8":
+        return {"gen_state": host}
+    if repr_ == "sparse":
+        return {"sparse_words": host, "ox": int(extra["ox"]),
+                "oy": int(extra["oy"]), "size": int(extra["size"])}
+    # u8 {0,1} cells -> the legacy {0,255} pixel format.
+    return {"world": (host * np.uint8(255)).astype(np.uint8)}
+
+
+def _alive_count(host: np.ndarray, repr_: str) -> int:
+    """Firing population of the host payload — the manifest's second
+    determinism marker, exact and representation-aware."""
+    if repr_ in ("packed", "sparse"):
+        return int(_POP8[host.view(np.uint8)].sum(dtype=np.int64))
+    if repr_ == "gen3":
+        return int(_POP8[host[0].view(np.uint8)].sum(dtype=np.int64))
+    if repr_ == "gen8":
+        return int((host == 1).sum(dtype=np.int64))
+    return int(host.sum(dtype=np.int64))
+
+
+class CheckpointWriter:
+    def __init__(self, directory: str, run_id: str,
+                 keep_last: int = 3, keep_every: int = 0) -> None:
+        self.directory = directory
+        self.run_id = run_id
+        self.retention = RetentionPolicy(keep_last=keep_last,
+                                         keep_every=keep_every)
+        self._cv = threading.Condition()
+        self._pending: Optional[Snapshot] = None
+        self._busy = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self.last_manifest: Optional[str] = None
+        self.last_error: Optional[BaseException] = None
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, snap: Snapshot) -> bool:
+        """Hand a snapshot to the background thread; returns False when
+        it REPLACED an unwritten pending snapshot (counted as dropped).
+        Never blocks beyond the condition lock."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("checkpoint writer is closed")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="gol-ckpt-writer")
+                self._thread.start()
+            replaced = self._pending is not None
+            self._pending = snap
+            self._cv.notify_all()
+        if replaced:
+            obs.CKPT_WRITES.labels(status="dropped").inc()
+        return not replaced
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the pending snapshot (if any) is durably written.
+        True on drained, False on timeout."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cv:
+            while self._pending is not None or self._busy:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._cv.wait(remaining)
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Flush then stop accepting snapshots. The daemon thread exits
+        on its own once drained."""
+        drained = self.flush(timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        return drained
+
+    # ----------------------------------------------------------- writing
+
+    def write_sync(self, snap: Snapshot) -> str:
+        """Write one checkpoint ON THIS THREAD (emergency/manual path);
+        returns the manifest path. Raises on failure — synchronous
+        callers (the Checkpoint wire method) need the error."""
+        return self._write(snap)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None and not self._closed:
+                    self._cv.wait()
+                if self._pending is None and self._closed:
+                    return
+                snap = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                self._write(snap)
+            except Exception as e:
+                # Periodic checkpointing must never kill the run it
+                # exists to protect; the failure is counted, logged,
+                # and kept for flush()-side inspection.
+                self.last_error = e
+                obs_log("ckpt.write_failed", level="error",
+                        turn=snap.turn, error=f"{type(e).__name__}: {e}")
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _write(self, snap: Snapshot) -> str:
+        t0 = time.monotonic()
+        with obs_trace.span("ckpt.save",
+                            attrs={"turn": snap.turn, "repr": snap.repr,
+                                   "trigger": snap.trigger}) as span:
+            try:
+                path = self._write_inner(snap)
+            except Exception:
+                obs.CKPT_WRITES.labels(status="error").inc()
+                raise
+            finally:
+                obs.CKPT_WRITE_SECONDS.observe(time.monotonic() - t0)
+            span.attrs["path"] = os.path.basename(path)
+        obs.CKPT_WRITES.labels(status="ok").inc()
+        obs.CKPT_LAST_TURN.set(snap.turn)
+        self.last_manifest = path
+        return path
+
+    def _write_inner(self, snap: Snapshot) -> str:
+        host = _materialize(snap)
+        arrays = payload_arrays(host, snap.repr, snap.extra)
+        payload_member = next(v for v in arrays.values()
+                              if hasattr(v, "nbytes"))
+        save = (np.savez_compressed
+                if payload_member.nbytes <= COMPRESS_LIMIT else np.savez)
+        base = mf.ckpt_basename(snap.turn)
+        payload_name = base + mf.PAYLOAD_SUFFIX
+        payload = os.path.join(self.directory, payload_name)
+        man_path = os.path.join(self.directory, base + mf.MANIFEST_SUFFIX)
+        # One writer mutates a directory at a time (the run's background
+        # writer vs a SIGTERM-handler write_sync on another thread):
+        # publishes stay ordered and retention never sweeps mid-publish.
+        with dir_lock(self.directory):
+            fd, tmp = tempfile.mkstemp(prefix=payload_name + ".",
+                                       suffix=".tmp", dir=self.directory)
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    save(f, turn=snap.turn, rulestring=snap.rule,
+                         **arrays)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, payload)  # payload published FIRST
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            payload_bytes = os.path.getsize(payload)
+            manifest = {
+                "schema": mf.MANIFEST_SCHEMA,
+                "run_id": self.run_id,
+                "turn": int(snap.turn),
+                "rule": snap.rule,
+                "repr": snap.repr,
+                "board": {"h": int(snap.board[0]),
+                          "w": int(snap.board[1])},
+                "dtype": str(payload_member.dtype),
+                "shape": [int(s) for s in payload_member.shape],
+                "payload": payload_name,
+                "payload_sha256": mf.sha256_file(payload),
+                "payload_bytes": int(payload_bytes),
+                "board_sha256": mf.board_sha256(arrays),
+                "alive": _alive_count(host, snap.repr),
+                "trigger": snap.trigger,
+                "created_unix": int(time.time()),
+                "writer": _writer_ident(),
+            }
+            if snap.extra:
+                manifest["sparse"] = {k: int(v)
+                                      for k, v in snap.extra.items()}
+            mf.write_manifest(man_path, manifest)  # durability bit LAST
+            obs.CKPT_BYTES.inc(payload_bytes)
+            self.retention.apply(self.directory, locked=True)
+        return man_path
+
+
+def _writer_ident() -> dict:
+    ident = {"pid": os.getpid()}
+    try:
+        import jax
+        import jaxlib
+
+        ident["jax"] = jax.__version__
+        ident["jaxlib"] = jaxlib.__version__
+    except Exception:  # version probing must never sink a checkpoint
+        pass
+    ident["numpy"] = np.__version__
+    return ident

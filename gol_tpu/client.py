@@ -255,6 +255,31 @@ class RemoteEngine:
                                  timeout=self._timeout)
         return world, (int(resp["ox"]), int(resp["oy"])), int(resp["turn"])
 
+    def checkpoint_now(self, directory: str = "",
+                       trigger: str = "manual") -> Tuple[str, int]:
+        """Trigger a durable manifest checkpoint on the SERVER (into its
+        configured GOL_CKPT directory — `directory` must be empty, the
+        client never chooses remote write paths); returns
+        (manifest basename, turn). Duck-types `Engine.checkpoint_now`
+        so the distributor's trigger path is engine-agnostic."""
+        if directory:
+            raise ValueError(
+                "remote checkpoints always land in the server's "
+                "configured directory")
+        # Generous timeout: the server write is synchronous (hash +
+        # fsync of a board that can be hundreds of MB).
+        resp, _ = self._call({"method": "Checkpoint"},
+                             timeout=max(self._timeout, 120.0))
+        return str(resp.get("manifest", "")), int(resp["turn"])
+
+    def restore_run(self, path: str = "") -> int:
+        """Adopt a checkpoint on the SERVER: empty `path` = the newest
+        durable checkpoint in its configured directory, else a
+        checkpoint name within it. Returns the restored turn."""
+        resp, _ = self._call({"method": "RestoreRun", "path": path},
+                             timeout=max(self._timeout, 120.0))
+        return int(resp["turn"])
+
     def cf_put(self, flag: int) -> None:
         self._call({"method": "CFput", "flag": int(flag)},
                    timeout=self._timeout)

@@ -2,7 +2,8 @@
 
 Re-design of reference `Local/gol/distributor.go:55-226`. Same observable
 contract — load `images/WxH.pgm`, drive the engine, emit the event stream,
-honour s/p/q/k keypresses, tick alive counts every 2 s, write
+honour s/p/q/k keypresses (plus 'c': durable checkpoint on demand),
+tick alive counts every 2 s, write
 `out/WxHxT.pgm`, support detach (`q`) / reattach (`CONT=yes`) — but the
 engine link is either an in-process `Engine` (default; the "no real
 cluster" test story) or a remote engine over the TCP control plane when
@@ -256,7 +257,8 @@ def distributor(
                 # One span per handled keypress, parented to the run
                 # root (this thread's own stack is empty). Names clamp
                 # to the known keys — span names must stay bounded.
-                kname = key if key in ("s", "p", "q", "k") else "other"
+                kname = (key if key in ("s", "p", "q", "k", "c")
+                         else "other")
                 with obs_trace.span(f"controller.key.{kname}",
                                     parent=root_ctx):
                     if key == "s":
@@ -299,6 +301,15 @@ def distributor(
                             events_q.put(
                                 ev.StateChange(turn, ev.State.EXECUTING)
                             )
+                    elif key == "c":
+                        # Durable manifest checkpoint on demand —
+                        # in-process engines write to GOL_CKPT, remote
+                        # ones via the Checkpoint wire method into the
+                        # server's configured directory.
+                        name, turn = engine.checkpoint_now(
+                            trigger="manual")
+                        print(f"checkpointed turn {turn} "
+                              f"({os.path.basename(name)})")
                     elif key == "q":
                         engine.cf_put(FLAG_QUIT)
                     elif key == "k":

@@ -746,6 +746,42 @@ class Engine(ControlFlagProtocol):
             os.makedirs(ckpt_dir, exist_ok=True)
             ckpt_path = os.path.join(ckpt_dir, f"{width}x{height}.npz")
         last_ckpt = time.monotonic()
+        # Manifest checkpointing (gol_tpu/ckpt): TURN-based cadence, so
+        # checkpoint turns are deterministic across runs — the legacy
+        # seconds-based autosave above keeps working independently.
+        ckpt_writer = None
+        next_ckpt_turn = None
+        ckpt_every_turns = 0
+        if ckpt_dir:
+            from gol_tpu import ckpt as ckpt_mod
+
+            ckpt_every_turns = env_int(
+                ckpt_mod.CKPT_EVERY_TURNS_ENV, 0, minimum=0)
+            if ckpt_every_turns > 0:
+                ckpt_writer = ckpt_mod.CheckpointWriter(
+                    ckpt_dir, run_id=obs_flight.RUN_ID,
+                    keep_last=env_int(ckpt_mod.CKPT_KEEP_ENV,
+                                      ckpt_mod.CKPT_KEEP_DEFAULT),
+                    keep_every=env_int(ckpt_mod.CKPT_KEEP_EVERY_ENV, 0,
+                                       minimum=0))
+                next_ckpt_turn = (
+                    start_turn // ckpt_every_turns + 1) * ckpt_every_turns
+
+        def _ckpt_submit(snap_cells, trigger: str) -> None:
+            """Queue a checkpoint of `snap_cells` at self._turn on the
+            background writer: a pointer hand-off plus an async
+            device→host copy kick — the turn loop never waits on disk
+            or transfer."""
+            from gol_tpu import ckpt as ckpt_mod
+
+            snap = ckpt_mod.Snapshot(
+                snap_cells, repr_, pad_rows, self._turn, (height, width),
+                self._rule.rulestring, trigger=trigger)
+            try:
+                snap_cells.copy_to_host_async()
+            except Exception:
+                pass  # not fatal: the writer's device_get still works
+            ckpt_writer.submit(snap)
         chunks_done = 0
         traced_chunks = 0
         # Flag-service seconds accrued since the last chunk record — the
@@ -882,7 +918,16 @@ class Engine(ControlFlagProtocol):
             while self._turn < target and not quit_run:
                 if self._killed or self._abort.is_set():
                     break
-                k = _next_chunk(chunk, target - self._turn)
+                k_cap = target - self._turn
+                if next_ckpt_turn is not None:
+                    # Land chunk boundaries exactly on checkpoint turns:
+                    # checkpoint turns become a pure function of
+                    # (start_turn, cadence), never of the adapter's
+                    # timing-dependent chunk sizes — which is what makes
+                    # an interrupted+resumed run's checkpoints comparable
+                    # turn-for-turn against an uninterrupted one.
+                    k_cap = min(k_cap, next_ckpt_turn - self._turn)
+                k = _next_chunk(chunk, k_cap)
                 # Trace the second chunk (first is compile-warmup), or the
                 # first when it is the whole run; the traced result is kept
                 # but its timing is not fed to the chunk adapter (profiler
@@ -948,6 +993,12 @@ class Engine(ControlFlagProtocol):
                 with self._state_lock:
                     self._cells = cells
                     self._turn += k
+                if (next_ckpt_turn is not None
+                        and self._turn >= next_ckpt_turn):
+                    _ckpt_submit(cells, "periodic")
+                    next_ckpt_turn = (
+                        self._turn // ckpt_every_turns + 1
+                    ) * ckpt_every_turns
                 if ckpt_path and \
                         time.monotonic() - last_ckpt >= ckpt_every:
                     self.save_checkpoint(ckpt_path)
@@ -965,12 +1016,26 @@ class Engine(ControlFlagProtocol):
                     if flag_cost > 0.01:
                         # A pause (or slow flag drain) stalled the host.
                         _reset_pace(time.monotonic())
+            if ckpt_writer is not None and chunks_done > 0:
+                # Every loop exit inside the try — completion, quit,
+                # kill, abort — leaves durable state at the final turn,
+                # so a restart resumes exactly where this run stopped.
+                _ckpt_submit(cells, "final")
         except Exception as e:
             # The black box: an unhandled chunk-loop error dumps the
             # flight ring — recent spans/events plus the chunk spans
             # still riding the pipeline — before the error propagates
             # to the dispatcher.
             obs_flight.crash("engine.run_loop", e, turn=self._turn)
+            if ckpt_writer is not None:
+                # Emergency best-effort checkpoint alongside the flight
+                # dump: synchronous (there is no later boundary to wait
+                # for) and never allowed to mask the original error.
+                try:
+                    ckpt_writer.write_sync(
+                        self._ckpt_snapshot("emergency"))
+                except Exception:
+                    pass
             raise
         finally:
             # Drain remaining in-flight chunks so the LAST publication is
@@ -1012,6 +1077,12 @@ class Engine(ControlFlagProtocol):
                 self._running = False
                 self._run_token = None
                 self._abort.clear()
+            if ckpt_writer is not None:
+                # Bounded drain: the run is over, so blocking here costs
+                # nothing pipelined — but a wedged disk must not park
+                # the engine forever (the daemon thread finishes or
+                # dies with the process).
+                ckpt_writer.close(timeout=60.0)
             obs.ENGINE_TURN.set(final_turn)
             if reporter is not None:
                 reporter.emit(
@@ -1152,6 +1223,52 @@ class Engine(ControlFlagProtocol):
     # larger ones are written raw — compressing a 512 MB packed flagship
     # board would dominate the checkpoint interval for little gain.
     CKPT_COMPRESS_LIMIT = 64 * 1024 * 1024
+
+    def _ckpt_snapshot(self, trigger: str = "manual"):
+        """Capture current state as a ckpt.Snapshot (lock-held pointer
+        copy — the expensive work happens in the writer)."""
+        from gol_tpu import ckpt as ckpt_mod
+
+        with self._state_lock:
+            cells, repr_ = self._cells, self._repr
+            pad, turn = self._pad_rows, self._turn
+        if cells is None:
+            raise RuntimeError("no board loaded")
+        h = cells.shape[-2] - pad
+        w = _board_width(cells, repr_)
+        return ckpt_mod.Snapshot(cells, repr_, pad, turn, (h, w),
+                                 self._rule.rulestring, trigger=trigger)
+
+    def checkpoint_now(self, directory: Optional[str] = None,
+                       trigger: str = "manual") -> Tuple[str, int]:
+        """Write one durable manifest checkpoint SYNCHRONOUSLY to
+        `directory` (default: the configured GOL_CKPT dir); returns
+        (manifest_path, turn). The Checkpoint wire method and the
+        SIGTERM handler land here — callers who need the durability
+        guarantee before proceeding."""
+        from gol_tpu import ckpt as ckpt_mod
+
+        d = directory or os.environ.get(CKPT_ENV, "")
+        if not d:
+            raise RuntimeError(
+                "checkpointing not configured: set GOL_CKPT or pass "
+                "--checkpoint DIR")
+        self._check_alive()
+        snap = self._ckpt_snapshot(trigger)
+        writer = ckpt_mod.CheckpointWriter(
+            d, run_id=obs_flight.RUN_ID,
+            keep_last=env_int(ckpt_mod.CKPT_KEEP_ENV,
+                              ckpt_mod.CKPT_KEEP_DEFAULT),
+            keep_every=env_int(ckpt_mod.CKPT_KEEP_EVERY_ENV, 0,
+                               minimum=0))
+        return writer.write_sync(snap), snap.turn
+
+    def restore_run(self, path: str) -> int:
+        """Verified manifest/legacy restore (ckpt.restore_engine over
+        this engine); returns the restored turn."""
+        from gol_tpu import ckpt as ckpt_mod
+
+        return ckpt_mod.restore_engine(self, path)
 
     def save_checkpoint(self, path: str) -> None:
         """Atomically write the board state + turn + rulestring as .npz.

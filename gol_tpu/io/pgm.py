@@ -13,6 +13,7 @@ boards are numpy arrays and hit disk in one write. Contracts preserved:
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -138,10 +139,30 @@ def write_pgm(path: str, board: np.ndarray, levels=None) -> None:
 
     height, width = board.shape
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    if native.write_pgm(path, board):
-        return
-    with open(path, "wb") as f:
-        f.write(MAGIC + b"\n")
-        f.write(f"{width} {height}\n".encode())
-        f.write(f"{MAXVAL}\n".encode())
-        f.write(board.tobytes())
+    # Atomic publish (tmp + fsync + rename, the same dance as
+    # ckpt/manifest.py): a crash or 'k' mid-write must never leave a
+    # torn out/*.pgm — readers see either the complete old file or the
+    # complete new one. The tmp name is per-writer (pid + thread) so
+    # concurrent writers to the same target can't interleave.
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        if native.write_pgm(tmp, board):
+            # The native codec wrote + closed tmp; fsync it before the
+            # rename so the publish is durable, not just atomic.
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        else:
+            with open(tmp, "wb") as f:
+                f.write(MAGIC + b"\n")
+                f.write(f"{width} {height}\n".encode())
+                f.write(f"{MAXVAL}\n".encode())
+                f.write(board.tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)

@@ -65,6 +65,29 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "images/WxH.pgm: a library name (glider, lwss, "
                          "rpentomino, gosper-gun, blinker) or a .rle file, "
                          "stamped centred on an empty WxH torus")
+    ap.add_argument("--checkpoint", metavar="DIR", default="",
+                    help="checkpoint directory (sets GOL_CKPT): the "
+                         "engine writes gol-ckpt/1 manifest checkpoints "
+                         "here when --ckpt-every is set, plus the legacy "
+                         "time-based autosave")
+    ap.add_argument("--ckpt-every", metavar="TURNS", type=int, default=0,
+                    help="manifest checkpoint cadence in TURNS (sets "
+                         "GOL_CKPT_EVERY_TURNS; 0 = off; requires "
+                         "--checkpoint)")
+    ap.add_argument("--ckpt-keep", metavar="N", type=int, default=0,
+                    help="retention: keep the newest N checkpoints "
+                         "(sets GOL_CKPT_KEEP; default 3; "
+                         "GOL_CKPT_KEEP_EVERY additionally pins every "
+                         "K-th turn)")
+    ap.add_argument("--resume", metavar="DIR|MANIFEST|NPZ", nargs="?",
+                    const="", default=None,
+                    help="resume from a checkpoint before running: a "
+                         "directory (newest durable manifest wins), a "
+                         "ckpt-*.json manifest (payload SHA-256 "
+                         "verified), or a legacy .npz; bare --resume "
+                         "uses --checkpoint / GOL_CKPT. With SER set "
+                         "the SERVER adopts the checkpoint from its own "
+                         "configured directory (RestoreRun)")
     ap.add_argument("--sparse", action="store_true",
                     help="run on the sparse-torus engine: -w/-h give the "
                          "TORUS size (equal, multiple of 32 — e.g. "
@@ -150,6 +173,14 @@ def main(argv=None) -> int:
         from gol_tpu.obs.timeline import RUN_REPORT_ENV
 
         os.environ[RUN_REPORT_ENV] = args.run_report
+    # Checkpoint knobs travel as env too — the engine reads them at run
+    # start (gol_tpu/ckpt package docstring has the full table).
+    if args.checkpoint:
+        os.environ["GOL_CKPT"] = args.checkpoint
+    if args.ckpt_every:
+        os.environ["GOL_CKPT_EVERY_TURNS"] = str(args.ckpt_every)
+    if args.ckpt_keep:
+        os.environ["GOL_CKPT_KEEP"] = str(args.ckpt_keep)
     from gol_tpu.obs import trace as obs_trace
 
     if args.trace_spans:
@@ -173,6 +204,53 @@ def main(argv=None) -> int:
                 f"--rule {rule.rulestring} has no effect with SER set: "
                 "the REMOTE engine's own rule governs the run — start "
                 "the server with --rule to match")
+    if args.resume is not None:
+        from gol_tpu.distributor import (
+            _resolve_engine,
+            _resolve_sparse_engine,
+        )
+
+        if os.environ.get("SER"):
+            # The SERVER adopts the checkpoint from its own configured
+            # directory (RestoreRun): the reference names a checkpoint
+            # there, or "" for its newest durable one.
+            turn = _resolve_engine(rule).restore_run(args.resume)
+        else:
+            ref = args.resume or os.environ.get("GOL_CKPT", "")
+            if not ref:
+                print("--resume needs DIR|MANIFEST|NPZ (or --checkpoint"
+                      " / GOL_CKPT to name the directory)",
+                      file=sys.stderr)
+                return 2
+            from gol_tpu import ckpt as ckpt_mod
+
+            kind, target = ckpt_mod.resolve(ref)
+            if kind == "manifest":
+                m = ckpt_mod.read_manifest(target)
+                if rule is None:
+                    # The manifest knows the run's rule — resuming must
+                    # not require re-stating it.
+                    from gol_tpu.models import parse_rule
+
+                    rule = parse_rule(m["rule"])
+                if (args.sparse and m["repr"] == "sparse"
+                        and m.get("board")):
+                    # A sparse manifest knows its torus size too.
+                    args.width = args.height = int(m["board"]["w"])
+                elif not args.sparse and m.get("board"):
+                    # Dense: adopt the checkpoint's board dims so the
+                    # out/WxHxT.pgm filename contract and the live-view
+                    # window describe the RESTORED board, not the -w/-h
+                    # defaults the user didn't type.
+                    args.width = int(m["board"]["w"])
+                    args.height = int(m["board"]["h"])
+            eng = (_resolve_sparse_engine(args.width, rule)
+                   if args.sparse else _resolve_engine(rule))
+            turn = eng.restore_run(target if kind == "manifest" else ref)
+        # Reattach to the restored engine-held state — the CONT=yes
+        # contract — instead of seeding a fresh board from images/.
+        os.environ["CONT"] = "yes"
+        print(f"resuming at turn {turn}", flush=True)
     p = Params(
         threads=args.threads,
         image_width=args.width,

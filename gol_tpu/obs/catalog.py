@@ -17,7 +17,7 @@ from gol_tpu.obs.metrics import REGISTRY
 WIRE_METHODS = (
     "ServerDistributor", "Alivecount", "GetWorld", "GetView", "GetWindow",
     "CFput", "DrainFlags", "KillProg", "Ping", "Stats", "AbortRun",
-    "GetMetrics", "unknown",
+    "GetMetrics", "Checkpoint", "RestoreRun", "unknown",
 )
 
 # ----------------------------------------------------------------- engine
@@ -145,3 +145,34 @@ for _r in FLIGHT_REASONS:
 def flight_reason_label(reason: str) -> str:
     """Clamp arbitrary dump reasons to the declared set."""
     return reason if reason in FLIGHT_REASONS else "unknown"
+
+
+# ------------------------------------------------------------- checkpoints
+
+CKPT_WRITES = REGISTRY.counter(
+    "gol_ckpt_writes_total",
+    "Checkpoint write attempts by the ckpt writer, by outcome: ok "
+    "(durable manifest published), error (write pipeline raised), "
+    "dropped (snapshot superseded before the disk caught up).",
+    label_names=("status",))
+CKPT_WRITE_SECONDS = REGISTRY.histogram(
+    "gol_ckpt_write_seconds",
+    "Wall seconds per checkpoint write (device→host copy, serialize, "
+    "hash, atomic publish, retention) — on the background writer "
+    "thread, overlapping engine compute.")
+CKPT_BYTES = REGISTRY.counter(
+    "gol_ckpt_bytes_total",
+    "Payload bytes durably published by the ckpt writer.")
+CKPT_LAST_TURN = REGISTRY.gauge(
+    "gol_ckpt_last_turn",
+    "Turn of the most recent durable checkpoint (manifest published).")
+CKPT_RESTORES = REGISTRY.counter(
+    "gol_ckpt_restores_total",
+    "Checkpoint restore attempts, by outcome: ok, rejected (integrity "
+    "verification refused the checkpoint), error.",
+    label_names=("status",))
+
+for _s in ("ok", "error", "dropped"):
+    CKPT_WRITES.labels(status=_s)
+for _s in ("ok", "rejected", "error"):
+    CKPT_RESTORES.labels(status=_s)

@@ -59,6 +59,54 @@ from gol_tpu.ops.bitpack import (
 # temporaries of the adder network live at once (measured: a 3.3 MB board
 # allocates ~49 MB scoped VMEM), so its board budget is VMEM_LIMIT / 16.
 VMEM_LIMIT_BYTES = 64 * 1024 * 1024
+
+# jax renamed pltpu.TPUCompilerParams to pltpu.CompilerParams across
+# releases; resolve whichever this jax ships so the kernels (and their
+# interpret-mode tests) work on both sides of the rename. None means the
+# installed pallas has neither spelling — `interpret_supported()` then
+# reports the capability as absent and the tier-1 pallas tests skip with
+# that reason instead of erroring (docs/PARITY.md).
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams", None
+)
+
+_INTERPRET_PROBE: "tuple[bool, str] | None" = None
+
+
+def interpret_supported() -> "tuple[bool, str]":
+    """Capability probe: can this jax run these kernels in interpret
+    mode on the current backend?  Returns (ok, reason); the reason is
+    the skip message tests surface when ok is False. Probes once per
+    process with a trivial copy kernel — cheap, and exercises the same
+    pallas_call + CompilerParams surface the real kernels use."""
+    global _INTERPRET_PROBE
+    if _INTERPRET_PROBE is None:
+        if _COMPILER_PARAMS_CLS is None:
+            _INTERPRET_PROBE = (
+                False,
+                "pallas.tpu has neither CompilerParams nor "
+                "TPUCompilerParams (jax rename drift)",
+            )
+        else:
+            def _copy(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            try:
+                x = jnp.zeros((8, 128), jnp.uint32)
+                pl.pallas_call(
+                    _copy,
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    compiler_params=_COMPILER_PARAMS_CLS(
+                        vmem_limit_bytes=VMEM_LIMIT_BYTES
+                    ),
+                    interpret=True,
+                )(x)
+                _INTERPRET_PROBE = (True, "")
+            except Exception as e:  # noqa: BLE001 — any failure = skip
+                _INTERPRET_PROBE = (
+                    False, f"pallas interpret probe failed: "
+                           f"{type(e).__name__}: {e}")
+    return _INTERPRET_PROBE
 VMEM_BOARD_BYTES = VMEM_LIMIT_BYTES // 16
 
 # The banded kernel's working set is different: the scratch window is
@@ -213,7 +261,7 @@ def _vmem_pallas_call(kernel, operand, interpret: bool):
         out_shape=jax.ShapeDtypeStruct(operand.shape, operand.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS_CLS(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,
@@ -361,7 +409,7 @@ def _banded_pass(
         # Bands are independent (they all read the unchanged input), so
         # the grid is parallel — Mosaic splits it across TensorCores on
         # multi-core chips (free on the 1-core v5e this was tuned on).
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS_CLS(
             vmem_limit_bytes=VMEM_LIMIT_BYTES,
             dimension_semantics=("parallel",),
         ),

@@ -20,7 +20,7 @@ from gol_tpu.sdl.window import Window
 
 
 def _stdin_key_reader(key_presses: "queue.Queue", stop: threading.Event):
-    """Stdin reader thread: forwards s/p/q/k keystrokes. Terminal mode is
+    """Stdin reader thread: forwards s/p/q/k/c keystrokes. Terminal mode is
     owned by `start()` (set + restored there). select() gates every
     read(1) so the thread actually exits when `stop` is set — a reader
     parked in a blocking read would outlive its run and steal the user's
@@ -37,7 +37,7 @@ def _stdin_key_reader(key_presses: "queue.Queue", stop: threading.Event):
         ch = sys.stdin.read(1)
         if not ch:
             return
-        if ch in ("s", "p", "q", "k"):
+        if ch in ("s", "p", "q", "k", "c"):
             key_presses.put(ch)
         if ch in ("q", "k"):
             return

@@ -24,7 +24,8 @@ import numpy as np
 from gol_tpu.engine import Engine, EngineBusy, EngineKilled
 from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import flight as obs_flight
-from gol_tpu.obs import log as obs_log
+from gol_tpu.obs.log import exception as obs_exception
+from gol_tpu.obs.log import log as obs_log
 from gol_tpu.obs import trace
 from gol_tpu.obs.metrics import REGISTRY
 from gol_tpu.params import Params
@@ -203,6 +204,16 @@ class EngineServer:
                 self.engine.drain_flags(
                     pause_only=bool(header.get("pause_only", False)))
                 send_msg(conn, {"ok": True})
+            elif method == "Checkpoint":
+                # Controller-triggered durable snapshot into the
+                # server's CONFIGURED directory (GOL_CKPT) — the client
+                # never chooses write paths on this host.
+                path, turn = self.engine.checkpoint_now(trigger="remote")
+                send_msg(conn, {"ok": True, "turn": turn,
+                                "manifest": os.path.basename(path)})
+            elif method == "RestoreRun":
+                turn = self._restore_run(str(header.get("path", "")))
+                send_msg(conn, {"ok": True, "turn": turn})
             elif method == "KillProg":
                 self.engine.kill_prog()
                 send_msg(conn, {"ok": True})
@@ -217,12 +228,37 @@ class EngineServer:
         except EngineKilled as e:
             obs.SERVER_ERRORS.labels(method=label).inc()
             send_msg(conn, {"ok": False, "error": f"killed: {e}"})
+        except PermissionError as e:
+            obs.SERVER_ERRORS.labels(method=label).inc()
+            send_msg(conn, {"ok": False, "error": f"denied: {e}"})
         except EngineBusy as e:
             obs.SERVER_ERRORS.labels(method=label).inc()
             send_msg(conn, {"ok": False, "error": f"busy: {e}"})
         except Exception as e:  # surface engine errors to the client
             obs.SERVER_ERRORS.labels(method=label).inc()
             send_msg(conn, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+    def _restore_run(self, req: str) -> int:
+        """RestoreRun target resolution: the request names a checkpoint
+        WITHIN the server's configured directory (relative name, or an
+        absolute path that realpath-resolves inside it) — or nothing,
+        meaning the newest durable checkpoint there. A remote peer must
+        not be able to point the engine at arbitrary host files."""
+        from gol_tpu.engine import CKPT_ENV
+
+        base = os.environ.get(CKPT_ENV, "")
+        if not base:
+            raise RuntimeError(
+                "checkpointing not configured: set GOL_CKPT or pass "
+                "--checkpoint DIR")
+        target = os.path.join(base, req) if req else base
+        real_base = os.path.realpath(base)
+        real_target = os.path.realpath(target)
+        if (real_target != real_base
+                and not real_target.startswith(real_base + os.sep)):
+            raise PermissionError(
+                f"restore path {req!r} escapes the checkpoint directory")
+        return self.engine.restore_run(target)
 
 
 def _final_flush(reason: str) -> None:
@@ -253,9 +289,25 @@ def main() -> None:
                          "trace-event JSON (Perfetto-loadable) to PATH "
                          "on shutdown (sets GOL_TRACE_SPANS; a "
                          "directory gets one file per pid)")
-    ap.add_argument("--resume", metavar="CKPT", default="",
-                    help="restore (world, turn) from a checkpoint .npz "
-                         "before serving (pairs with GOL_CKPT autosaves)")
+    ap.add_argument("--resume", metavar="DIR|MANIFEST|NPZ", default="",
+                    help="restore (world, turn) before serving: a "
+                         "checkpoint directory (newest durable manifest "
+                         "wins), a ckpt-*.json manifest (payload "
+                         "SHA-256 verified), or a legacy .npz autosave")
+    ap.add_argument("--checkpoint", metavar="DIR", default="",
+                    help="checkpoint directory (sets GOL_CKPT): runs "
+                         "write gol-ckpt/1 manifest checkpoints here "
+                         "when --ckpt-every is set, plus the legacy "
+                         "time-based autosave")
+    ap.add_argument("--ckpt-every", metavar="TURNS", type=int, default=0,
+                    help="manifest checkpoint cadence in TURNS (sets "
+                         "GOL_CKPT_EVERY_TURNS; 0 = off; requires "
+                         "--checkpoint)")
+    ap.add_argument("--ckpt-keep", metavar="N", type=int, default=0,
+                    help="retention: keep the newest N checkpoints "
+                         "(sets GOL_CKPT_KEEP; default 3; "
+                         "GOL_CKPT_KEEP_EVERY additionally pins every "
+                         "K-th turn)")
     ap.add_argument("--coordinator", metavar="HOST:PORT", default="",
                     help="multi-host engine: jax.distributed coordinator "
                          "address (falls back to GOL_COORDINATOR; unset = "
@@ -277,6 +329,14 @@ def main() -> None:
     args = ap.parse_args()
     if args.trace_spans:
         os.environ[trace.TRACE_SPANS_ENV] = args.trace_spans
+    # Checkpoint knobs travel as env (the engine reads them at run
+    # start, same pattern as every GOL_* knob).
+    if args.checkpoint:
+        os.environ["GOL_CKPT"] = args.checkpoint
+    if args.ckpt_every:
+        os.environ["GOL_CKPT_EVERY_TURNS"] = str(args.ckpt_every)
+    if args.ckpt_keep:
+        os.environ["GOL_CKPT_KEEP"] = str(args.ckpt_keep)
     trace.set_process_name("gol-server")
     # Join the multi-host engine cluster FIRST: jax.distributed must
     # initialize before ANYTHING touches the XLA backend (including the
@@ -303,7 +363,7 @@ def main() -> None:
         eng = Engine(rule=rule)
     srv = EngineServer(port=args.port, host=args.host, engine=eng)
     if args.resume:
-        turn = srv.engine.load_checkpoint(args.resume)
+        turn = srv.engine.restore_run(args.resume)
         print(f"restored checkpoint {args.resume} at turn {turn}")
 
     # Graceful shutdown: with checkpointing configured (GOL_CKPT), a
@@ -316,6 +376,14 @@ def main() -> None:
     def _on_term(signo, frame):
         ckpt_dir = os.environ.get(CKPT_ENV, "")
         if ckpt_dir:
+            # Durable manifest checkpoint first (verified, retained,
+            # resumable by --resume DIR); the legacy single-file
+            # autosave rides along for pre-manifest tooling.
+            try:
+                path, turn = srv.engine.checkpoint_now(trigger="sigterm")
+                obs_log("server.sigterm_checkpoint", turn=turn, path=path)
+            except Exception as e:
+                obs_exception("server.sigterm_checkpoint_failed", e)
             try:
                 # stats() gives (board geometry, turn) without the full
                 # board transfer get_world() would cost.
@@ -325,10 +393,8 @@ def main() -> None:
                     os.makedirs(ckpt_dir, exist_ok=True)
                     path = os.path.join(ckpt_dir, f"{w}x{h}.npz")
                     srv.engine.save_checkpoint(path)
-                    obs_log.log("server.sigterm_checkpoint",
-                                turn=s["turn"], path=path)
             except Exception as e:
-                obs_log.exception("server.sigterm_checkpoint_failed", e)
+                obs_exception("server.sigterm_checkpoint_failed", e)
         # After the checkpoint (the dump should record its log event,
         # and a slow checkpoint must not delay the black box by dying
         # first — dump is sub-ms either way).

@@ -47,6 +47,7 @@ from gol_tpu.engine import (
 )
 from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.models.sparse import SparseTorus
+from gol_tpu.obs import flight as obs_flight
 from gol_tpu.ops.bitpack import WORD_BITS, unpack
 from gol_tpu.utils.envcfg import env_float, env_int
 
@@ -176,11 +177,37 @@ class SparseEngine(ControlFlagProtocol):
             ckpt_path = os.path.join(
                 ckpt_dir, f"sparse{self.size}x{self.size}.npz")
         last_ckpt = time.monotonic()
+        # Turn-based manifest checkpointing, same contract as the dense
+        # engine: chunk boundaries clamped onto checkpoint turns so the
+        # cadence is timing-independent and resume-comparable.
+        ckpt_writer = None
+        next_ckpt_turn = None
+        ckpt_every_turns = 0
+        if ckpt_dir:
+            from gol_tpu import ckpt as ckpt_mod
+
+            ckpt_every_turns = env_int(
+                ckpt_mod.CKPT_EVERY_TURNS_ENV, 0, minimum=0)
+            if ckpt_every_turns > 0:
+                ckpt_writer = ckpt_mod.CheckpointWriter(
+                    ckpt_dir, run_id=obs_flight.RUN_ID,
+                    keep_last=env_int(ckpt_mod.CKPT_KEEP_ENV,
+                                      ckpt_mod.CKPT_KEEP_DEFAULT),
+                    keep_every=env_int(ckpt_mod.CKPT_KEEP_EVERY_ENV, 0,
+                                       minimum=0))
+                next_ckpt_turn = (
+                    start_turn // ckpt_every_turns + 1) * ckpt_every_turns
+
+        def _ckpt_submit(trigger: str) -> None:
+            ckpt_writer.submit(self._ckpt_snapshot(trigger))
+
         try:
             while self._turn < target and not quit_run:
                 if self._killed or self._abort.is_set():
                     break
                 k = min(chunk, target - self._turn)
+                if next_ckpt_turn is not None:
+                    k = min(k, next_ckpt_turn - self._turn)
                 t0 = time.monotonic()
                 self._torus.run(k)
                 # One poll-free (alive, turn) pair per chunk; fetching the
@@ -198,13 +225,31 @@ class SparseEngine(ControlFlagProtocol):
                     chunk *= 2
                 elif elapsed > chunk_target * 2 and chunk > 1:
                     chunk //= 2
+                if (next_ckpt_turn is not None
+                        and self._turn >= next_ckpt_turn):
+                    _ckpt_submit("periodic")
+                    next_ckpt_turn = (
+                        self._turn // ckpt_every_turns + 1
+                    ) * ckpt_every_turns
                 if ckpt_path and \
                         time.monotonic() - last_ckpt >= ckpt_every:
                     self.save_checkpoint(ckpt_path)
                     last_ckpt = time.monotonic()
                 if self._turn < target:
                     quit_run = self._handle_flags()
+            if ckpt_writer is not None and self._turn > start_turn:
+                _ckpt_submit("final")
+        except Exception:
+            if ckpt_writer is not None:
+                try:
+                    ckpt_writer.write_sync(
+                        self._ckpt_snapshot("emergency"))
+                except Exception:
+                    pass
+            raise
         finally:
+            if ckpt_writer is not None:
+                ckpt_writer.close(timeout=60.0)
             with self._state_lock:
                 final_pub = self._pub
                 final_turn = self._turn
@@ -296,6 +341,49 @@ class SparseEngine(ControlFlagProtocol):
             }
 
     # -------------------------------------------------------- checkpointing
+
+    def _ckpt_snapshot(self, trigger: str = "manual"):
+        """Current published (window, origin, turn) as a ckpt.Snapshot
+        (repr "sparse"; the writer serializes the window words plus the
+        origin/size scalars into the legacy sparse npz format)."""
+        from gol_tpu import ckpt as ckpt_mod
+
+        with self._state_lock:
+            pub = self._pub
+        if pub is None:
+            raise RuntimeError("no board loaded")
+        packed, ox, oy, turn, _ = pub
+        return ckpt_mod.Snapshot(
+            packed, "sparse", 0, turn, (self.size, self.size),
+            self._rule.rulestring, trigger=trigger,
+            extra={"size": self.size, "ox": ox, "oy": oy})
+
+    def checkpoint_now(self, directory: Optional[str] = None,
+                       trigger: str = "manual") -> Tuple[str, int]:
+        """Synchronous durable manifest checkpoint — same contract as
+        `Engine.checkpoint_now` (Checkpoint wire method, SIGTERM)."""
+        from gol_tpu import ckpt as ckpt_mod
+
+        d = directory or os.environ.get(CKPT_ENV, "")
+        if not d:
+            raise RuntimeError(
+                "checkpointing not configured: set GOL_CKPT or pass "
+                "--checkpoint DIR")
+        self._check_alive()
+        snap = self._ckpt_snapshot(trigger)
+        writer = ckpt_mod.CheckpointWriter(
+            d, run_id=obs_flight.RUN_ID,
+            keep_last=env_int(ckpt_mod.CKPT_KEEP_ENV,
+                              ckpt_mod.CKPT_KEEP_DEFAULT),
+            keep_every=env_int(ckpt_mod.CKPT_KEEP_EVERY_ENV, 0,
+                               minimum=0))
+        return writer.write_sync(snap), snap.turn
+
+    def restore_run(self, path: str) -> int:
+        """Verified manifest/legacy restore; returns the restored turn."""
+        from gol_tpu import ckpt as ckpt_mod
+
+        return ckpt_mod.restore_engine(self, path)
 
     def save_checkpoint(self, path: str) -> None:
         """Atomic .npz of (window words, origin, torus size, turn,

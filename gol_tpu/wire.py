@@ -10,6 +10,15 @@ its own densest trivial encoding — no base64, no gob).
 Message: { "method"/"ok": ..., ...fields..., "world": {"h": H, "w": W}? }
 followed by exactly H*W raw payload bytes when "world" is present.
 
+Durability methods (PR 3): `Checkpoint` (no fields) asks the engine for
+a synchronous gol-ckpt/1 manifest checkpoint into ITS configured
+directory and replies {"turn", "manifest"}; `RestoreRun` {"path"?}
+adopts a checkpoint from within that directory (empty path = newest
+durable) and replies {"turn"}. Checkpoint payloads never cross the
+wire — only names and turns — so the methods stay O(header) regardless
+of board size, and the server refuses path components that escape its
+checkpoint directory ("denied:" error prefix).
+
 Trace context: when the sending thread has an open span (obs/trace.py)
 and the header carries no explicit "tc", send_msg stamps the span's
 compact context — `"tc": {"t": <trace_id>, "s": <span_id>}` — into the

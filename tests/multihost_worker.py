@@ -46,7 +46,19 @@ def main() -> None:
     sharding = board_sharding(mesh)
     arr = jax.make_array_from_callback(
         (n, n), sharding, lambda idx: board[idx])
-    out = sharded_run_turns(arr, turns, mesh)
+    try:
+        out = sharded_run_turns(arr, turns, mesh)
+        jax.block_until_ready(out)
+    except Exception as e:
+        # Some jaxlib builds can form the 2-process gloo cluster but
+        # cannot EXECUTE cross-process computations on the CPU backend
+        # ("Multiprocess computations aren't implemented"). That is a
+        # backend capability gap, not a framework bug — emit the skip
+        # sentinel the parent test recognises (docs/PARITY.md).
+        if "Multiprocess computations aren't implemented" in str(e):
+            print(f"MULTIHOST_UNSUPPORTED proc {pid}: {e}", flush=True)
+            sys.exit(0)
+        raise
 
     want = run_turns_np(board, turns)
     shards = list(out.addressable_shards)

@@ -1,0 +1,332 @@
+"""Checkpoint/restore subsystem contracts (gol_tpu/ckpt): manifest
+integrity, retention, the async double-buffered writer, and — the one
+that matters — bit-identical resume vs an uninterrupted run for every
+engine representation the subsystem serializes."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import ckpt
+from gol_tpu.ckpt import manifest as mf
+from gol_tpu.params import Params
+
+
+def random_pixels(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < density).astype(np.uint8)) * 255
+
+
+def write_one(tmp_path, turn=7, seed=1, keep_last=10, **extra):
+    """One durable checkpoint from a host-side u8 snapshot; returns the
+    manifest path."""
+    cells = (random_pixels(16, 16, seed=seed) // 255).astype(np.uint8)
+    snap = ckpt.Snapshot(cells, "u8", 0, turn, cells.shape, "B3/S23",
+                         **extra)
+    w = ckpt.CheckpointWriter(str(tmp_path), run_id="test",
+                              keep_last=keep_last)
+    return w.write_sync(snap)
+
+
+# ------------------------------------------------------------- manifest
+
+
+def test_manifest_roundtrip_and_verify(tmp_path):
+    path = write_one(tmp_path, turn=42)
+    m = mf.read_manifest(path)
+    assert m["schema"] == ckpt.MANIFEST_SCHEMA
+    assert m["turn"] == 42
+    assert m["rule"] == "B3/S23"
+    assert m["repr"] == "u8"
+    assert m["board"] == {"h": 16, "w": 16}
+    # verify recomputes the payload hash and agrees
+    assert mf.verify_manifest(path)["turn"] == 42
+    # the payload is the legacy npz format load_checkpoint understands
+    payload = mf.payload_path(path, m)
+    with np.load(payload) as z:
+        assert int(z["turn"]) == 42
+        assert str(z["rulestring"]) == "B3/S23"
+
+
+def test_manifest_rejects_missing_and_mistyped_fields(tmp_path):
+    path = write_one(tmp_path)
+    m = mf.read_manifest(path)
+    for field in ("schema", "run_id", "turn", "rule", "repr", "payload",
+                  "payload_sha256", "payload_bytes", "board_sha256"):
+        bad = dict(m)
+        del bad[field]
+        p = str(tmp_path / "bad.json")
+        with open(p, "w") as f:
+            json.dump(bad, f)
+        with pytest.raises(ckpt.CheckpointIntegrityError):
+            mf.read_manifest(p)
+    # wrong type: turn as string
+    bad = dict(m, turn="42")
+    p = str(tmp_path / "bad2.json")
+    with open(p, "w") as f:
+        json.dump(bad, f)
+    with pytest.raises(ckpt.CheckpointIntegrityError):
+        mf.read_manifest(p)
+
+
+def test_manifest_payload_traversal_rejected(tmp_path):
+    """The payload field must be a bare basename — a manifest naming a
+    path outside its own directory is hostile, not broken."""
+    path = write_one(tmp_path)
+    m = mf.read_manifest(path)
+    for evil in ("../escape.npz", "/etc/passwd", "a/b.npz"):
+        bad = dict(m, payload=evil)
+        p = str(tmp_path / "evil.json")
+        with open(p, "w") as f:
+            json.dump(bad, f)
+        with pytest.raises(ckpt.CheckpointIntegrityError):
+            mf.read_manifest(p)
+
+
+def test_corrupted_payload_refused(tmp_path):
+    """Flipped payload bytes → SHA-256 mismatch → hard refusal. The
+    resume path runs this exact check (restore_engine verify=True)."""
+    path = write_one(tmp_path)
+    payload = mf.payload_path(path, mf.read_manifest(path))
+    raw = bytearray(open(payload, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(payload, "wb") as f:
+        f.write(raw)
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="SHA-256"):
+        mf.verify_manifest(path)
+
+
+def test_truncated_payload_refused(tmp_path):
+    path = write_one(tmp_path)
+    payload = mf.payload_path(path, mf.read_manifest(path))
+    raw = open(payload, "rb").read()
+    with open(payload, "wb") as f:
+        f.write(raw[:-8])
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="bytes"):
+        mf.verify_manifest(path)
+
+
+def test_board_sha256_distinguishes_dtype_and_shape():
+    a = np.arange(16, dtype=np.uint8)
+    assert (mf.board_sha256({"x": a})
+            != mf.board_sha256({"x": a.astype(np.uint32)}))
+    assert (mf.board_sha256({"x": a.reshape(4, 4)})
+            != mf.board_sha256({"x": a.reshape(2, 8)}))
+    assert mf.board_sha256({"x": a}) == mf.board_sha256({"x": a.copy()})
+
+
+def test_list_checkpoints_skips_malformed(tmp_path):
+    write_one(tmp_path, turn=5)
+    write_one(tmp_path, turn=9)
+    junk = tmp_path / f"{mf.CKPT_PREFIX}junk{mf.MANIFEST_SUFFIX}"
+    junk.write_text("{not json")
+    turns = [t for t, _, _ in ckpt.list_checkpoints(str(tmp_path))]
+    assert turns == [5, 9]
+    with pytest.raises(ckpt.CheckpointIntegrityError):
+        list(ckpt.list_checkpoints(str(tmp_path), strict=True))
+
+
+# ------------------------------------------------------------ retention
+
+
+def test_retention_keeps_last_n_and_pinned_multiples(tmp_path):
+    w = ckpt.CheckpointWriter(str(tmp_path), run_id="test",
+                              keep_last=2, keep_every=100)
+    cells = np.zeros((8, 8), np.uint8)
+    for turn in (50, 100, 150, 200):
+        w.write_sync(ckpt.Snapshot(cells, "u8", 0, turn, (8, 8),
+                                   "B3/S23"))
+    turns = [t for t, _, _ in ckpt.list_checkpoints(str(tmp_path))]
+    # last 2 = {150, 200}; keep_every=100 pins 100 and 200; 50 is GC'd
+    assert turns == [100, 150, 200]
+    # every survivor still verifies, and the newest is never deleted
+    for _, path, _ in ckpt.list_checkpoints(str(tmp_path)):
+        mf.verify_manifest(path)
+
+
+def test_retention_deletes_manifest_before_payload(tmp_path):
+    """Crash-safety of GC ordering: a checkpoint must never exist as a
+    manifest whose payload is gone (that would verify-fail on resume);
+    an orphan payload is merely garbage, swept later."""
+    order = []
+    real_unlink = os.unlink
+
+    def spy(path, *a, **k):
+        order.append(os.path.basename(path))
+        return real_unlink(path, *a, **k)
+
+    w = ckpt.CheckpointWriter(str(tmp_path), run_id="test", keep_last=1)
+    cells = np.zeros((8, 8), np.uint8)
+    w.write_sync(ckpt.Snapshot(cells, "u8", 0, 1, (8, 8), "B3/S23"))
+    import gol_tpu.ckpt.retention as retention_mod
+    orig = retention_mod.os.unlink
+    retention_mod.os.unlink = spy
+    try:
+        w.write_sync(ckpt.Snapshot(cells, "u8", 0, 2, (8, 8), "B3/S23"))
+    finally:
+        retention_mod.os.unlink = orig
+    victims = [n for n in order if n.startswith(mf.CKPT_PREFIX)]
+    assert victims, "retention deleted nothing?"
+    assert victims[0].endswith(mf.MANIFEST_SUFFIX)
+
+
+# --------------------------------------------------------------- writer
+
+
+def test_async_writer_double_buffer_drops_stale(tmp_path):
+    """submit() never queues unboundedly: while one write is in flight,
+    a newer snapshot REPLACES the pending one (newest state wins)."""
+    gate = threading.Event()
+    cells = np.zeros((8, 8), np.uint8)
+
+    class SlowSnap(ckpt.Snapshot):
+        def __init__(self, turn):
+            super().__init__(cells, "u8", 0, turn, (8, 8), "B3/S23")
+
+    w = ckpt.CheckpointWriter(str(tmp_path), run_id="test", keep_last=99)
+    # First submit starts the writer; block it inside _materialize by
+    # handing it an object whose __array__ waits on the gate.
+
+    class Blocker:
+        shape = (8, 8)
+        dtype = np.uint8
+
+        def __array__(self, dtype=None, copy=None):
+            gate.wait(30)
+            return cells
+
+    s0 = ckpt.Snapshot(Blocker(), "u8", 0, 1, (8, 8), "B3/S23")
+    assert w.submit(s0)
+    for turn in (2, 3, 4):
+        time.sleep(0.02)
+        w.submit(SlowSnap(turn))  # 3 and 4 replace 2 then 3
+    gate.set()
+    assert w.close(timeout=30)
+    turns = [t for t, _, _ in ckpt.list_checkpoints(str(tmp_path))]
+    assert turns[-1] == 4, turns            # newest always survives
+    assert len(turns) <= 3                  # at least one was superseded
+
+
+def test_writer_submit_does_not_block(tmp_path):
+    w = ckpt.CheckpointWriter(str(tmp_path), run_id="test")
+    cells = np.zeros((256, 256), np.uint8)
+    t0 = time.monotonic()
+    for turn in range(20):
+        w.submit(ckpt.Snapshot(cells, "u8", 0, turn, cells.shape,
+                               "B3/S23"))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.0, f"submit stalled the caller: {elapsed:.2f}s"
+    assert w.close(timeout=60)
+
+
+# ------------------------------------------------- resume determinism
+
+
+def _dense_resume_case(width, tmp_path, monkeypatch, expected_repr):
+    """Run 0→100 with periodic checkpoints; restore a mid-run manifest
+    into a FRESH engine, run to 100, compare byte-identical."""
+    from gol_tpu.engine import Engine
+
+    ckdir = str(tmp_path / "ck")
+    monkeypatch.setenv("GOL_CKPT", ckdir)
+    monkeypatch.setenv("GOL_CKPT_EVERY_TURNS", "16")
+    monkeypatch.setenv("GOL_CKPT_KEEP", "99")
+    world = random_pixels(256, width, seed=3)
+    p = Params(turns=100, image_height=256, image_width=width)
+
+    e1 = Engine()
+    final1, t1 = e1.server_distributor(p, world.copy())
+    assert t1 == 100
+    assert e1._repr == expected_repr
+
+    monkeypatch.delenv("GOL_CKPT")  # resume leg writes no checkpoints
+    items = [it for it in ckpt.list_checkpoints(ckdir) if it[0] < 100]
+    assert items, "no mid-run checkpoint survived"
+    turn, manifest_path, m = items[-1]
+    assert turn % 16 == 0, "chunk clamp must land checkpoints on cadence"
+    assert m["repr"] == expected_repr
+
+    e2 = Engine()
+    assert e2.restore_run(manifest_path) == turn
+    w2, t2 = e2.get_world()
+    assert t2 == turn
+    final2, t3 = e2.server_distributor(
+        Params(turns=100 - turn, image_height=256, image_width=width),
+        w2, start_turn=turn)
+    assert t3 == 100
+    np.testing.assert_array_equal(final2, final1)
+
+
+def test_resume_bit_identical_packed(tmp_path, monkeypatch):
+    _dense_resume_case(256, tmp_path, monkeypatch, "packed")
+
+
+def test_resume_bit_identical_u8(tmp_path, monkeypatch):
+    # width 250 is not a multiple of 32 → the uint8 representation
+    _dense_resume_case(250, tmp_path, monkeypatch, "u8")
+
+
+def test_resume_bit_identical_sparse(tmp_path, monkeypatch):
+    from gol_tpu.sparse_engine import SparseEngine
+
+    ckdir = str(tmp_path / "ck")
+    monkeypatch.setenv("GOL_CKPT", ckdir)
+    monkeypatch.setenv("GOL_CKPT_EVERY_TURNS", "16")
+    monkeypatch.setenv("GOL_CKPT_KEEP", "99")
+    seed = random_pixels(64, 64, seed=11)
+    p = Params(turns=100, image_height=64, image_width=64)
+
+    e1 = SparseEngine(256)
+    final1, t1 = e1.server_distributor(p, seed.copy())
+    assert t1 == 100
+
+    monkeypatch.delenv("GOL_CKPT")
+    items = [it for it in ckpt.list_checkpoints(ckdir) if it[0] < 100]
+    assert items, "no mid-run sparse checkpoint survived"
+    turn, manifest_path, m = items[-1]
+    assert m["repr"] == "sparse"
+
+    e2 = SparseEngine(256)
+    assert e2.restore_run(manifest_path) == turn
+    final2, t3 = e2.server_distributor(
+        Params(turns=100 - turn, image_height=64, image_width=64),
+        None, start_turn=turn)
+    assert t3 == 100
+    np.testing.assert_array_equal(final2, final1)
+
+
+def test_restore_rejects_turn_mismatch(tmp_path):
+    """A manifest whose recorded turn disagrees with the payload's is
+    internally inconsistent — refused even though both hashes check out
+    (the hash covers the payload, the cross-check covers the pair)."""
+    from gol_tpu.engine import Engine
+
+    path = write_one(tmp_path, turn=7)
+    m = mf.read_manifest(path)
+    doctored = dict(m, turn=9)
+    p2 = str(tmp_path / f"{mf.CKPT_PREFIX}{9:012d}{mf.MANIFEST_SUFFIX}")
+    mf.write_manifest(p2, doctored)
+    os.unlink(path)  # only the doctored manifest remains
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="turn"):
+        Engine().restore_run(str(tmp_path))
+
+
+def test_resolve_prefers_latest_durable(tmp_path):
+    write_one(tmp_path, turn=5)
+    p9 = write_one(tmp_path, turn=9)
+    kind, target = ckpt.resolve(str(tmp_path))
+    assert kind == "manifest" and target == p9
+    with pytest.raises(FileNotFoundError):
+        ckpt.resolve(str(tmp_path / "empty"))
+
+
+def test_checkpoint_now_requires_configuration(tmp_path):
+    from gol_tpu.engine import Engine
+
+    e = Engine()
+    with pytest.raises(RuntimeError, match="GOL_CKPT"):
+        e.checkpoint_now()

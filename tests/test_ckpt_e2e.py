@@ -1,0 +1,121 @@
+"""Crash-recovery e2e for the manifest checkpoint subsystem: a real
+engine-server process SIGKILLed mid-run must be replaceable by a fresh
+process that `--resume`s its newest durable gol-ckpt/1 checkpoint and
+finishes the run bit-identical to an uninterrupted one (proven against
+the independent numpy oracle). Plus the refusal side: a server pointed
+at a corrupted checkpoint must die loudly, never serve wrong state."""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+from gol_tpu import ckpt
+from gol_tpu.ckpt import manifest as mf
+from gol_tpu.client import RemoteEngine
+from gol_tpu.ops.reference import run_turns_np
+from gol_tpu.params import Params
+from tests.server_harness import spawn_server, wait_port
+
+
+def random_pixels(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < density).astype(np.uint8)) * 255
+
+
+def test_sigkill_resume_manifest_bit_identical(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    env = {"GOL_MAX_CHUNK": "8"}  # small chunks: fresh checkpoints
+    proc1 = spawn_server(
+        0, tmp_path, extra_env=env,
+        extra_args=("--checkpoint", ckdir, "--ckpt-every", "8",
+                    "--ckpt-keep", "4"))
+    proc2 = None
+    try:
+        port = wait_port(proc1)
+        assert port, "server 1 never announced its port"
+
+        world0 = random_pixels(64, 64, seed=5)
+        eng = RemoteEngine(f"127.0.0.1:{port}", timeout=30.0)
+
+        def run():  # dies with the server — that's the point
+            try:
+                eng.server_distributor(
+                    Params(threads=2, image_width=64, image_height=64,
+                           turns=10**8), world0)
+            except Exception:
+                pass
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+
+        # Wait for a few durable checkpoints, then pull the plug.
+        deadline = time.monotonic() + 120
+        while True:
+            latest = mf.latest_checkpoint(ckdir)
+            if latest is not None and latest[0] >= 24:
+                break
+            assert time.monotonic() < deadline, "no durable checkpoint"
+            time.sleep(0.05)
+        os.kill(proc1.pid, signal.SIGKILL)
+        proc1.wait(10)
+        t.join(30)
+
+        # The newest durable checkpoint survived the SIGKILL intact —
+        # hashes verify even though the writer died mid-flight.
+        turn0, manifest_path, m = mf.latest_checkpoint(ckdir)
+        mf.verify_manifest(manifest_path)
+        assert turn0 % 8 == 0, "checkpoint turns must sit on the cadence"
+
+        # Replacement process restores the directory's newest durable
+        # checkpoint and serves exactly that (world, turn).
+        proc2 = spawn_server(0, tmp_path, resume=ckdir)
+        port2 = wait_port(proc2)
+        assert port2, "replacement server never announced its port"
+        eng2 = RemoteEngine(f"127.0.0.1:{port2}", timeout=30.0)
+        w2, t2 = eng2.get_world()
+        assert t2 == turn0
+
+        # Finish the run; bit-identity vs an uninterrupted run is
+        # proven against the independent oracle from the ORIGINAL seed.
+        final, tf = eng2.server_distributor(
+            Params(threads=2, image_width=64, image_height=64, turns=40),
+            w2, start_turn=t2)
+        assert tf == turn0 + 40
+        want = run_turns_np((world0 != 0).astype(np.uint8), tf)
+        np.testing.assert_array_equal((final != 0).astype(np.uint8), want)
+    finally:
+        for p in (proc1, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(10)
+
+
+def test_server_refuses_corrupted_checkpoint(tmp_path):
+    """Hash-mismatch refusal across the process boundary: --resume on a
+    directory whose newest payload was corrupted must abort startup
+    (non-zero exit, no 'serving on' banner) — never serve wrong state."""
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    cells = (random_pixels(16, 16, seed=2) // 255).astype(np.uint8)
+    w = ckpt.CheckpointWriter(str(ckdir), run_id="test")
+    path = w.write_sync(
+        ckpt.Snapshot(cells, "u8", 0, 12, (16, 16), "B3/S23"))
+    payload = mf.payload_path(path, mf.read_manifest(path))
+    raw = bytearray(open(payload, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(payload, "wb") as f:
+        f.write(raw)
+
+    proc = spawn_server(0, tmp_path, resume=str(ckdir))
+    try:
+        out, _ = proc.communicate(timeout=120)
+    except Exception:
+        proc.kill()
+        raise
+    assert proc.returncode != 0, out[-2000:]
+    assert "serving on" not in out
+    assert "SHA-256" in out or "CheckpointIntegrityError" in out, \
+        out[-2000:]

@@ -108,6 +108,12 @@ def test_two_process_mesh_evolution(repo_root):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any("MULTIHOST_UNSUPPORTED" in out for out in outs):
+        # Capability gate: the workers formed the cluster but this
+        # jaxlib's CPU backend cannot execute cross-process collectives
+        # (see multihost_worker.py and docs/PARITY.md).
+        pytest.skip("CPU backend does not implement multiprocess "
+                    "computations in this jaxlib")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (
             f"worker {pid} failed:\n{out[-3000:]}")

@@ -7,7 +7,13 @@ import os
 import subprocess
 import sys
 
-import tomllib
+import pytest
+
+# stdlib tomllib landed in Python 3.11; on 3.10 the entry-point check
+# below has no TOML parser to lean on (tomli is not a declared
+# dependency), so it skips rather than errors (docs/PARITY.md).
+tomllib = pytest.importorskip(
+    "tomllib", reason="tomllib requires Python 3.11+")
 
 
 def test_console_entry_points_resolve(repo_root):

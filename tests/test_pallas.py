@@ -10,10 +10,18 @@ from gol_tpu.ops.bitpack import pack, unpack
 from gol_tpu.ops.pallas_stencil import (
     VMEM_BOARD_BYTES,
     fits_in_vmem,
+    interpret_supported,
     pallas_packed_run_turns,
 )
 from gol_tpu.ops.reference import run_turns_np
 from gol_tpu.ops.stencil import run_turns
+
+# Capability gate, not an xfail: pallas interpret mode has broken before
+# under jax API drift (the TPUCompilerParams/CompilerParams rename —
+# docs/PARITY.md). Probe once and skip the whole module with the probe's
+# reason where unsupported; run everywhere else.
+_PALLAS_OK, _PALLAS_WHY = interpret_supported()
+pytestmark = pytest.mark.skipif(not _PALLAS_OK, reason=_PALLAS_WHY)
 
 
 def random_board(h, w, seed=0, density=0.3):

@@ -146,6 +146,11 @@ def test_deep_halo_T_equals_shard_rows():
 def test_deep_halo_pallas_interpret_inner():
     # Exercise the pallas kernel as the per-shard inner engine (interpret
     # mode on CPU) — the exact composition the TPU multi-chip path uses.
+    from gol_tpu.ops.pallas_stencil import interpret_supported
+
+    ok, why = interpret_supported()
+    if not ok:  # capability gate, see docs/PARITY.md
+        pytest.skip(why)
     board = random_board(32, 64, seed=23)
     mesh = make_mesh(4)
     sharded = shard_board(pack(board), mesh)
@@ -159,6 +164,11 @@ def test_deep_halo_banded_interpret_inner():
     # The banded HBM kernel as the per-shard inner engine — what the TPU
     # multi-chip path composes for big lane-aligned per-shard windows.
     # Width 4096 (wp=128) with 128-row shards: window 128+2*16 = 160 rows.
+    from gol_tpu.ops.pallas_stencil import interpret_supported
+
+    ok, why = interpret_supported()
+    if not ok:  # capability gate, see docs/PARITY.md
+        pytest.skip(why)
     board = random_board(512, 4096, seed=29)
     mesh = make_mesh(4)
     sharded = shard_board(pack(board), mesh)

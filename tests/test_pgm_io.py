@@ -81,3 +81,40 @@ def test_comment_heavy_header_parses_with_or_without_native(tmp_path):
     p.write_bytes(b"P5\n" + comments + b"16 16\n255\n" + bytes(256))
     board = read_pgm(str(p))
     assert board.shape == (16, 16) and board.sum() == 0
+
+
+def test_write_is_atomic_against_torn_writes(tmp_path, monkeypatch):
+    """A crash between writing the tmp file and publishing it must leave
+    either the complete old file or the complete new one — never a torn
+    out/*.pgm (io/pgm.py's tmp + fsync + os.replace dance)."""
+    import os
+
+    import gol_tpu.io.pgm as pgm_mod
+
+    rng = np.random.default_rng(7)
+    old = ((rng.random((16, 16)) < 0.5).astype(np.uint8)) * 255
+    new = 255 - old
+    path = str(tmp_path / "b.pgm")
+    write_pgm(path, old)
+
+    # Simulate the crash: os.replace raises after the new payload is
+    # fully on disk in the tmp file but before it is published.
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("simulated crash between write and rename")
+
+    monkeypatch.setattr(pgm_mod.os, "replace", boom)
+    with pytest.raises(OSError, match="simulated crash"):
+        write_pgm(path, new)
+    monkeypatch.setattr(pgm_mod.os, "replace", real_replace)
+
+    # The published file is still the complete OLD board, and the tmp
+    # was cleaned up — no torn or stray files.
+    np.testing.assert_array_equal(read_pgm(path), old)
+    assert os.listdir(tmp_path) == ["b.pgm"]
+
+    # And the retried write publishes the complete NEW board.
+    write_pgm(path, new)
+    np.testing.assert_array_equal(read_pgm(path), new)
+    assert os.listdir(tmp_path) == ["b.pgm"]
