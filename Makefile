@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -19,6 +19,15 @@ obs-smoke:
 # payloads, prove retention safety (tools/ckpt_smoke.py).
 ckpt-smoke:
 	JAX_PLATFORMS=cpu python tools/ckpt_smoke.py
+
+# Perf-regression gate: compare the latest BENCH_r*.json artifact (or
+# PERF_CANDIDATE=<file>) against the committed BASELINE.json published
+# metrics; exits nonzero on a >10% regression of a gated throughput
+# metric (tools/perf_compare.py). Highest round number wins — mtimes
+# are meaningless after a fresh checkout.
+perf-gate:
+	python tools/perf_compare.py BASELINE.json \
+		$${PERF_CANDIDATE:-$$(ls BENCH_r*.json | sort | tail -1)}
 
 native:
 	$(MAKE) -C csrc

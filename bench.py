@@ -184,6 +184,55 @@ def _roofline_detail(cups: float, measure_peak: bool = False) -> dict:
     return out
 
 
+# Most recent XLA cost-model readout (set by _xla_cost), stamped into
+# the self-report's run_end bookend.
+_LAST_XLA_COST = None
+
+
+def _xla_cost(run, cells, turns, mesh):
+    """XLA's own cost model for one compiled `turns`-turn step:
+    lower+compile the exact program the timed leg runs and normalise
+    `cost_analysis()` to {"flops", "bytes_accessed"} (None where the
+    backend offers no cost model). The compile is cache-warm — the leg
+    already compiled this (cells, turns) shape."""
+    global _LAST_XLA_COST
+    try:
+        import jax
+
+        from gol_tpu.obs import devstats
+
+        compiled = (jax.jit(lambda c: run(c, turns, mesh))
+                    .lower(cells).compile())
+        cost = devstats.compiled_cost(compiled)
+    except Exception:  # never let the cost model sink a leg
+        return None
+    if cost is not None:
+        _LAST_XLA_COST = cost
+    return cost
+
+
+def _xla_roofline_check(cost, n: int, turns: int) -> dict:
+    """Cross-check the hand-derived roofline against XLA's cost model.
+
+    The roofline's OPS_PER_WORD_TURN (39 bitops per packed word-turn =
+    39/32 per cell-turn) is a dataflow count; XLA reports the compiled
+    HLO's flops. The delta is reported, not asserted — HLO flop
+    accounting treats fused bitwise ops differently per backend, so the
+    ratio is a drift tripwire, not an identity."""
+    model_per_cell_turn = OPS_PER_WORD_TURN / 32
+    out = {
+        "flops": cost["flops"],
+        "bytes_accessed": cost["bytes_accessed"],
+        "model_ops_per_cell_turn": round(model_per_cell_turn, 4),
+    }
+    if cost["flops"] is not None and turns * n * n > 0:
+        per_cell_turn = cost["flops"] / (turns * n * n)
+        out["xla_flops_per_cell_turn"] = round(per_cell_turn, 4)
+        out["xla_vs_model"] = round(per_cell_turn / model_per_cell_turn,
+                                    3)
+    return out
+
+
 # --self-report reporter: when set, every _emit line is mirrored as a
 # gol-run-report/1 `bench_leg` record, so bench artifacts live in the
 # same schema family as engine run reports (gol_tpu/obs/timeline.py).
@@ -416,6 +465,10 @@ def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
         # inflate utilization by the device count.
         detail["roofline"] = _roofline_detail(cups / max(n_shards, 1))
         detail["roofline"]["normalized_per_device"] = n_shards
+        cost = _xla_cost(sharded_run_turns, cells, turns, mesh)
+        if cost is not None:
+            detail["roofline"]["xla_cost"] = _xla_roofline_check(
+                cost, n, turns)
     _emit(
         f"cell-updates/sec ({n}x{n} torus)",
         round(cups, 1), "cell-updates/s",
@@ -704,6 +757,14 @@ def main() -> int:
             ident["host"] = platform.node()
         except Exception:
             pass
+        try:
+            from gol_tpu.obs import devstats
+
+            snap = devstats.poll_device_memory()
+            ident["dev_live_bytes"] = snap["live_bytes"]
+            ident["dev_peak_bytes"] = snap["peak_bytes"]
+        except Exception:
+            pass
         _SELF_REPORTER.emit("run_start", w=0, h=0, source="bench",
                             **ident)
     # Same entry-point cache policy as the CLI/server: the bench compiles
@@ -714,6 +775,35 @@ def main() -> int:
 
     gol_tpu.maybe_enable_default_compile_cache()
 
+    rc = 1
+    try:
+        rc = _dispatch(args, ap)
+        return rc
+    finally:
+        if _SELF_REPORTER is not None:
+            # run_end bookend: device memory footprint after the legs
+            # plus the last XLA cost readout, so a single bench
+            # artifact carries measurement AND cost model. Schema
+            # requires numeric turn/turns_total/chunks; a bench run
+            # has no board turns, so they are 0.
+            tail = {"rc": rc}
+            try:
+                from gol_tpu.obs import devstats
+
+                snap = devstats.poll_device_memory()
+                tail["device_kind"] = snap["device_kind"]
+                tail["dev_live_bytes"] = snap["live_bytes"]
+                tail["dev_peak_bytes"] = snap["peak_bytes"]
+            except Exception:
+                pass
+            if _LAST_XLA_COST is not None:
+                tail["xla_cost"] = _LAST_XLA_COST
+            _SELF_REPORTER.emit("run_end", turn=0, turns_total=0,
+                                chunks=0, source="bench", **tail)
+            _SELF_REPORTER.close()
+
+
+def _dispatch(args, ap) -> int:
     if args.ksweep:
         if args.size is None or args.pattern != "dense" or args.gen \
                 or args.engine:

@@ -277,6 +277,9 @@ class CheckpointWriter:
                 "created_unix": int(time.time()),
                 "writer": _writer_ident(),
             }
+            device = _device_ident()
+            if device is not None:
+                manifest["device"] = device
             if snap.extra:
                 manifest["sparse"] = {k: int(v)
                                       for k, v in snap.extra.items()}
@@ -297,4 +300,24 @@ def _writer_ident() -> dict:
     except Exception:  # version probing must never sink a checkpoint
         pass
     ident["numpy"] = np.__version__
+    return ident
+
+
+def _device_ident() -> Optional[dict]:
+    """Device kind + memory footprint for the manifest, or None.
+
+    read_manifest tolerates extra keys, so old readers skip this block;
+    memory fields appear only where the backend reports stats."""
+    try:
+        from gol_tpu.obs import devstats
+
+        snap = devstats.poll_device_memory()
+    except Exception:  # telemetry must never sink a checkpoint
+        return None
+    if snap["device_kind"] is None:
+        return None
+    ident = {"kind": snap["device_kind"], "devices": snap["devices"]}
+    if snap["supported"]:
+        ident["live_bytes"] = snap["live_bytes"]
+        ident["peak_bytes"] = snap["peak_bytes"]
     return ident

@@ -272,6 +272,16 @@ class RemoteEngine:
                              timeout=max(self._timeout, 120.0))
         return str(resp.get("manifest", "")), int(resp["turn"])
 
+    def profile(self, turns: int = 0) -> dict:
+        """Arm an on-demand jax.profiler capture of the next `turns`
+        engine turns on the SERVER (into its configured --profile-dir —
+        the client never chooses remote write paths). `turns=0` returns
+        the profile controller's status instead of arming."""
+        resp, _ = self._call({"method": "Profile", "turns": int(turns)},
+                             timeout=self._timeout)
+        resp.pop("ok", None)
+        return dict(resp)
+
     def restore_run(self, path: str = "") -> int:
         """Adopt a checkpoint on the SERVER: empty `path` = the newest
         durable checkpoint in its configured directory, else a

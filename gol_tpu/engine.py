@@ -49,7 +49,9 @@ import numpy as np
 from gol_tpu.models.generations import GenerationsRule
 from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import devstats as obs_devstats
 from gol_tpu.obs import flight as obs_flight
+from gol_tpu.obs import prof as obs_prof
 from gol_tpu.obs import timeline as obs_timeline
 from gol_tpu.obs import trace as obs_trace
 from gol_tpu.ops.bitpack import pack, packed_alive_count, unpack
@@ -462,6 +464,9 @@ class Engine(ControlFlagProtocol):
         to 1-D when the board or device count doesn't fit the request."""
         self._devices = list(devices if devices is not None else jax.devices())
         self._rule = rule
+        # Compile observability: jax.monitoring listeners behind the
+        # gol_compile_* families. Idempotent — safe across engines.
+        obs_devstats.install_compile_hooks()
         if mesh_shape is None:
             spec = os.environ.get("GOL_MESH", "").lower()
             if "x" in spec:
@@ -716,6 +721,11 @@ class Engine(ControlFlagProtocol):
         # slower link) the adapters re-correct within a few chunks.
         hint_key = (cells.shape, repr_, tuple(mesh.devices.shape),
                     self._chunk_target)
+        # Recompile-churn signal: a new (repr, shape, dtype, mesh, rule)
+        # tuple means jit will trace + compile a fresh step executable.
+        obs_devstats.note_signature(
+            (repr_, tuple(cells.shape), str(cells.dtype),
+             tuple(mesh.devices.shape), self._rule.rulestring))
         # Floor to a power of two <= the cap: min() alone would hand a
         # non-power-of-two GOL_MAX_CHUNK straight to the dispatch loop,
         # breaking the bounded-compiled-program invariant (_next_chunk).
@@ -738,6 +748,15 @@ class Engine(ControlFlagProtocol):
                 devices=int(mesh.size), turns_requested=params.turns,
                 start_turn=start_turn)
         obs.ENGINE_CHUNK_SIZE.set(chunk)
+        # GOL_PROFILE_DIR: one-shot env contract (set by --profile-dir)
+        # — arm an on-demand profiler capture of this run's first
+        # GOL_PROFILE_TURNS turns. A Profile RPC / POST /profile can arm
+        # later ones; the loop below is the sole consumer either way.
+        obs_prof.arm_from_env()
+        # Device memory baseline for this run; also refreshes the
+        # healthz cache (graceful no-op on stat-less backends).
+        obs_devstats.poll_device_memory()
+        last_devpoll = time.monotonic()
         trace_dir = os.environ.get(TRACE_ENV, "")
         ckpt_dir = os.environ.get(CKPT_ENV, "")
         ckpt_every = env_float(CKPT_EVERY_ENV, CKPT_EVERY_DEFAULT)
@@ -844,7 +863,7 @@ class Engine(ControlFlagProtocol):
             regime-appropriate chunk adapter (floor-based for
             synchronous measurements — the ramp and depth-1 mode —
             windowed-rate once the pipeline is open)."""
-            nonlocal chunk, last_pop, ramping, flag_pending
+            nonlocal chunk, last_pop, ramping, flag_pending, last_devpoll
             (_done_cells, done_token, done_k, done_turn,
              done_issue, done_span) = inflight.popleft()
             t_wait = time.monotonic()
@@ -904,6 +923,12 @@ class Engine(ControlFlagProtocol):
             done_span.attrs.update(alive=done_alive,
                                    token_wait_s=round(token_wait, 6))
             obs_trace.finish(done_span)
+            if now - last_devpoll >= 2.0:
+                # Throttled gol_dev_* refresh: memory_stats() is a cheap
+                # local counter read, but once per chunk at µs chunk
+                # walls would still be noise.
+                obs_devstats.poll_device_memory()
+                last_devpoll = now
 
         # The run span: parents every chunk/flag span below, and itself
         # parents under whatever is on this thread's context stack — the
@@ -918,6 +943,42 @@ class Engine(ControlFlagProtocol):
             while self._turn < target and not quit_run:
                 if self._killed or self._abort.is_set():
                     break
+                preq = obs_prof.PROFILER.take()
+                if preq is not None:
+                    # On-demand capture (Profile RPC / POST /profile /
+                    # GOL_PROFILE_DIR): drain the pipeline so the trace
+                    # shows only the requested turns, run them
+                    # synchronously under jax.profiler, and account them
+                    # as traced chunks — profiler-skewed timing stays
+                    # out of the pace/CUPS aggregates, exactly like the
+                    # GOL_TRACE path below.
+                    while inflight:
+                        _pop_oldest()
+                    profile_to = min(self._turn + preq.turns, target)
+                    with obs_prof.PROFILER.capture(preq):
+                        with obs_trace.span(
+                                "engine.profile",
+                                attrs={"turns": profile_to - self._turn,
+                                       "source": preq.source}):
+                            while self._turn < profile_to:
+                                k = _next_chunk(chunk,
+                                                profile_to - self._turn)
+                                cells = run(cells, k, mesh, self._rule)
+                                wait(cells)
+                                chunks_done += 1
+                                traced_chunks += 1
+                                obs.ENGINE_TRACED_CHUNKS_TOTAL.inc()
+                                obs.ENGINE_TURNS_TOTAL.inc(k)
+                                with self._state_lock:
+                                    self._cells = cells
+                                    self._turn += k
+                                obs.ENGINE_TURN.set(self._turn)
+                                if reporter is not None:
+                                    reporter.emit("traced_chunk",
+                                                  turn=self._turn,
+                                                  turns=k)
+                    _reset_pace(time.monotonic())
+                    continue
                 k_cap = target - self._turn
                 if next_ckpt_turn is not None:
                     # Land chunk boundaries exactly on checkpoint turns:
@@ -1085,12 +1146,19 @@ class Engine(ControlFlagProtocol):
                 ckpt_writer.close(timeout=60.0)
             obs.ENGINE_TURN.set(final_turn)
             if reporter is not None:
+                # Final gol_dev_* poll so the run report carries the
+                # run's device footprint (None fields on stat-less
+                # backends — the schema grows by addition).
+                devmem = obs_devstats.poll_device_memory()
                 reporter.emit(
                     "run_end", turn=final_turn,
                     turns_total=final_turn - start_turn,
                     chunks=chunks_done - traced_chunks,
                     traced_chunks=traced_chunks,
-                    wall_s=round(time.monotonic() - run_t0, 6))
+                    wall_s=round(time.monotonic() - run_t0, 6),
+                    device_kind=devmem["device_kind"],
+                    dev_live_bytes=devmem["live_bytes"],
+                    dev_peak_bytes=devmem["peak_bytes"])
                 reporter.close()
             run_span.attrs["final_turn"] = final_turn
             obs_trace.TRACER.pop(run_span)

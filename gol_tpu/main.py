@@ -37,6 +37,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="enable the live board view (polls snapshots)")
     ap.add_argument("--trace", metavar="DIR", default="",
                     help="dump one jax.profiler chunk trace to DIR")
+    ap.add_argument("--profile-dir", metavar="DIR", default="",
+                    help="capture a jax.profiler trace of the run's "
+                         "first --profile-turns turns into DIR "
+                         "(XPlane + Perfetto-loadable trace.json.gz); "
+                         "equivalent to GOL_PROFILE_DIR=DIR")
+    ap.add_argument("--profile-turns", type=int, default=0,
+                    metavar="N",
+                    help="turns per profiler capture (sets "
+                         "GOL_PROFILE_TURNS; default 256)")
     ap.add_argument("--run-report", metavar="PATH", default="",
                     help="append a JSON-lines chunk-timeline run report "
                          "(schema gol-run-report/1) to PATH; equivalent "
@@ -167,6 +176,16 @@ def main(argv=None) -> int:
         from gol_tpu.engine import TRACE_ENV
 
         os.environ[TRACE_ENV] = args.trace
+    # Same env-var contract: the engine arms one capture per run start
+    # while GOL_PROFILE_DIR is set (obs/prof.arm_from_env).
+    if args.profile_dir:
+        from gol_tpu.obs.prof import PROFILE_DIR_ENV
+
+        os.environ[PROFILE_DIR_ENV] = args.profile_dir
+    if args.profile_turns:
+        from gol_tpu.obs.prof import PROFILE_TURNS_ENV
+
+        os.environ[PROFILE_TURNS_ENV] = str(args.profile_turns)
     if args.run_report:
         # Same env-var contract as --trace: the engine reads it at run
         # time, so remote/forked engines inherit it too.

@@ -6,7 +6,7 @@ pulling a device runtime. See docs/OBSERVABILITY.md.
 """
 
 from gol_tpu.obs import catalog  # declare every metric family up front
-from gol_tpu.obs import flight, trace
+from gol_tpu.obs import devstats, flight, prof, trace
 from gol_tpu.obs.flight import FLIGHT, FlightRecorder
 from gol_tpu.obs.log import exception, log
 from gol_tpu.obs.metrics import REGISTRY, Registry, get_registry
@@ -20,4 +20,7 @@ __all__ = [
     "RUN_REPORT_ENV", "SCHEMA", "log", "exception",
     "trace", "flight", "TRACER", "Tracer", "Span",
     "FLIGHT", "FlightRecorder",
+    "devstats", "prof", "PROFILER",
 ]
+
+from gol_tpu.obs.prof import PROFILER  # noqa: E402  (jax-free; lazy jax)

@@ -17,7 +17,7 @@ from gol_tpu.obs.metrics import REGISTRY
 WIRE_METHODS = (
     "ServerDistributor", "Alivecount", "GetWorld", "GetView", "GetWindow",
     "CFput", "DrainFlags", "KillProg", "Ping", "Stats", "AbortRun",
-    "GetMetrics", "Checkpoint", "RestoreRun", "unknown",
+    "GetMetrics", "Checkpoint", "RestoreRun", "Profile", "unknown",
 )
 
 # ----------------------------------------------------------------- engine
@@ -176,3 +176,67 @@ for _s in ("ok", "error", "dropped"):
     CKPT_WRITES.labels(status=_s)
 for _s in ("ok", "rejected", "error"):
     CKPT_RESTORES.labels(status=_s)
+
+# -------------------------------------------------------- device telemetry
+
+# Values come from devstats.poll_device_memory(); this module stays free
+# of jax imports so control-plane processes (client, tools) can scrape
+# the catalogue without a device runtime.
+DEV_LIVE_BYTES = REGISTRY.gauge(
+    "gol_dev_live_bytes",
+    "Live device memory in bytes per device, from "
+    "device.memory_stats(); absent stats (CPU backends) leave the "
+    "family empty and flip gol_dev_mem_supported to 0.",
+    label_names=("device",))
+DEV_PEAK_BYTES = REGISTRY.gauge(
+    "gol_dev_peak_bytes",
+    "Peak device memory in bytes per device since process start, from "
+    "device.memory_stats().",
+    label_names=("device",))
+DEV_LIMIT_BYTES = REGISTRY.gauge(
+    "gol_dev_limit_bytes",
+    "Device memory capacity in bytes per device, where the backend "
+    "reports one.",
+    label_names=("device",))
+DEV_MEM_SUPPORTED = REGISTRY.gauge(
+    "gol_dev_mem_supported",
+    "1 if device.memory_stats() returns data on this backend, else 0.")
+DEV_DEVICES = REGISTRY.gauge(
+    "gol_dev_devices",
+    "Number of addressable devices visible to the process.")
+
+# ------------------------------------------------------------ compilation
+
+COMPILE_TOTAL = REGISTRY.counter(
+    "gol_compile_total",
+    "XLA backend compilations observed via jax.monitoring (cache hits "
+    "do not count).")
+COMPILE_CACHE_HITS = REGISTRY.counter(
+    "gol_compile_cache_hits_total",
+    "Persistent compilation-cache hits observed via jax.monitoring.")
+COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "gol_compile_cache_misses_total",
+    "Persistent compilation-cache misses observed via jax.monitoring.")
+COMPILE_SECONDS = REGISTRY.histogram(
+    "gol_compile_seconds",
+    "Wall seconds per XLA backend compilation.",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             120.0, 300.0))
+COMPILE_STEP_SIGNATURES = REGISTRY.counter(
+    "gol_compile_step_signatures_total",
+    "Distinct engine step signatures (representation, board shape, "
+    "dtype, mesh, rule) seen this process; each new one implies a "
+    "fresh jit trace + compile.")
+
+# --------------------------------------------------------------- profiler
+
+PROFILE_CAPTURES = REGISTRY.counter(
+    "gol_profile_captures_total",
+    "On-demand jax.profiler trace captures, by outcome.",
+    label_names=("status",))
+PROFILE_ARMED = REGISTRY.gauge(
+    "gol_profile_armed",
+    "1 while a profile capture request is pending or in progress.")
+
+for _s in ("ok", "error"):
+    PROFILE_CAPTURES.labels(status=_s)

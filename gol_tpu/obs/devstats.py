@@ -1,0 +1,254 @@
+"""Device memory + compilation telemetry (gol_dev_* / gol_compile_*).
+
+Bridges jax's runtime introspection into the dependency-free metrics
+registry.  jax is imported lazily inside the polling/hook functions so
+that importing this module (e.g. from obs/http.py for /healthz fields)
+never drags a device runtime into control-plane processes; every value
+healthz needs is cached here after the last poll.
+
+Three concerns live here:
+
+* ``poll_device_memory()`` — reads ``device.memory_stats()`` for each
+  addressable device and publishes live/peak/limit bytes gauges.
+  Backends without stats (CPU) are the common case in tests: the poll
+  degrades to ``gol_dev_mem_supported 0`` and returns ``None`` fields
+  rather than raising.
+* ``install_compile_hooks()`` — registers ``jax.monitoring`` listeners
+  that count backend compilations (cache hits excluded), record
+  compile wall time, and track persistent-cache hit/miss counters.
+  Idempotent: jax offers no unregister, so we install once per process.
+* ``note_signature()`` / ``compiled_cost()`` — engine-step signature
+  tracking (each distinct signature implies a fresh trace+compile) and
+  a normaliser for ``compiled.cost_analysis()`` whose return shape
+  varies across jax versions (list-of-dicts vs dict).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from gol_tpu.obs import catalog as _cat
+
+_lock = threading.Lock()
+_hooks_installed = False
+_signatures: set = set()
+
+# Cache of the last successful poll, consumed by healthz_fields() so the
+# HTTP layer never has to touch jax (and never blocks on a device sync).
+_last_poll: dict = {
+    "device_kind": None,
+    "devices": None,
+    "supported": None,
+    "live_bytes": None,
+    "peak_bytes": None,
+    "per_device": None,
+}
+
+# memory_stats() key aliases across backends.  TPU/GPU PJRT clients use
+# bytes_in_use/peak_bytes_in_use; bytes_limit is best-effort.
+_LIVE_KEYS = ("bytes_in_use", "bytes_used", "allocated_bytes")
+_PEAK_KEYS = ("peak_bytes_in_use", "peak_bytes")
+_LIMIT_KEYS = ("bytes_limit", "bytes_reservable_limit", "largest_alloc_size")
+
+
+def _first(stats: dict, keys) -> Optional[int]:
+    for k in keys:
+        v = stats.get(k)
+        if v is not None:
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                continue
+    return None
+
+
+def memory_snapshot(device: Any) -> Optional[dict]:
+    """Per-device memory stats, or None where the backend has none.
+
+    Returns ``{"live_bytes", "peak_bytes", "limit_bytes", "raw"}``;
+    ``raw`` keeps the backend's full stats dict for per-buffer
+    breakdowns where available.
+    """
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {
+        "live_bytes": _first(stats, _LIVE_KEYS),
+        "peak_bytes": _first(stats, _PEAK_KEYS),
+        "limit_bytes": _first(stats, _LIMIT_KEYS),
+        "raw": {k: int(v) for k, v in stats.items()
+                if isinstance(v, (int, float))},
+    }
+
+
+def device_kind() -> Optional[str]:
+    """Kind string of device 0 ("cpu", "TPU v4", ...), cached."""
+    with _lock:
+        if _last_poll["device_kind"] is not None:
+            return _last_poll["device_kind"]
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None
+    with _lock:
+        _last_poll["device_kind"] = kind
+    return kind
+
+
+def poll_device_memory() -> dict:
+    """Poll every addressable device, update gauges, cache for healthz.
+
+    Returns a summary dict; live/peak fields are None on backends
+    without memory stats (the graceful-None contract)."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        summary = {"device_kind": None, "devices": 0, "supported": False,
+                   "live_bytes": None, "peak_bytes": None,
+                   "per_device": {}}
+        with _lock:
+            _last_poll.update(summary)
+        return summary
+
+    per_device = {}
+    live_total = peak_total = 0
+    supported = False
+    for d in devices:
+        snap = memory_snapshot(d)
+        if snap is None:
+            continue
+        supported = True
+        dev_id = str(d.id)
+        per_device[dev_id] = {k: snap[k] for k in
+                              ("live_bytes", "peak_bytes", "limit_bytes")}
+        if snap["live_bytes"] is not None:
+            live_total += snap["live_bytes"]
+            _cat.DEV_LIVE_BYTES.labels(device=dev_id).set(
+                snap["live_bytes"])
+        if snap["peak_bytes"] is not None:
+            peak_total += snap["peak_bytes"]
+            _cat.DEV_PEAK_BYTES.labels(device=dev_id).set(
+                snap["peak_bytes"])
+        if snap["limit_bytes"] is not None:
+            _cat.DEV_LIMIT_BYTES.labels(device=dev_id).set(
+                snap["limit_bytes"])
+    _cat.DEV_MEM_SUPPORTED.set(1.0 if supported else 0.0)
+    _cat.DEV_DEVICES.set(float(len(devices)))
+
+    summary = {
+        "device_kind": devices[0].device_kind if devices else None,
+        "devices": len(devices),
+        "supported": supported,
+        "live_bytes": live_total if supported else None,
+        "peak_bytes": peak_total if supported else None,
+        "per_device": per_device,
+    }
+    with _lock:
+        _last_poll.update(summary)
+    return summary
+
+
+def healthz_fields() -> dict:
+    """Cached device fields for /healthz — never imports jax."""
+    with _lock:
+        cached = dict(_last_poll)
+    return {
+        "device_kind": cached["device_kind"],
+        "live_bytes": cached["live_bytes"],
+        "compile_count": int(_cat.COMPILE_TOTAL.value),
+    }
+
+
+# ------------------------------------------------------------ compile hooks
+
+# jax.monitoring event names (stable across 0.4.x).
+_COMPILE_DURATION_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    if event == _COMPILE_DURATION_EVENT:
+        _cat.COMPILE_TOTAL.inc()
+        _cat.COMPILE_SECONDS.observe(duration)
+
+
+def _on_event(event: str, **kw) -> None:
+    if event == _CACHE_HIT_EVENT:
+        _cat.COMPILE_CACHE_HITS.inc()
+    elif event == _CACHE_MISS_EVENT:
+        _cat.COMPILE_CACHE_MISSES.inc()
+
+
+def install_compile_hooks() -> bool:
+    """Register jax.monitoring listeners once per process.
+
+    Returns True if the hooks are (now) installed.  jax.monitoring has
+    no unregister API, so the guard is what keeps double-installs from
+    double-counting."""
+    global _hooks_installed
+    with _lock:
+        if _hooks_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:
+            return False
+        try:
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            monitoring.register_event_listener(_on_event)
+        except Exception:
+            return False
+        _hooks_installed = True
+    return True
+
+
+def note_signature(key: tuple) -> bool:
+    """Record an engine-step signature; True if it is new this process.
+
+    A new (representation, board shape, dtype, mesh, rule) tuple means
+    jit will trace and compile a fresh executable — the counter is the
+    operator-visible recompile-churn signal."""
+    with _lock:
+        if key in _signatures:
+            return False
+        _signatures.add(key)
+    _cat.COMPILE_STEP_SIGNATURES.inc()
+    return True
+
+
+def signature_count() -> int:
+    with _lock:
+        return len(_signatures)
+
+
+def compiled_cost(compiled: Any) -> Optional[dict]:
+    """Normalise compiled.cost_analysis() to {"flops", "bytes_accessed"}.
+
+    cost_analysis() returns a list of dicts on some jax versions and a
+    bare dict on others; keys use spaces ("bytes accessed").  Returns
+    None when the backend offers no cost model."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed", ca.get("bytes_accessed"))
+    if flops is None and nbytes is None:
+        return None
+    return {
+        "flops": float(flops) if flops is not None else None,
+        "bytes_accessed": float(nbytes) if nbytes is not None else None,
+    }

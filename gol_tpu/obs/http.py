@@ -6,9 +6,16 @@ serves three read-only paths from in-process state:
   * `/metrics` (and `/`) — Prometheus text from the shared registry;
   * `/metrics.json` — the registry's dict snapshot, for tooling that
     would rather not parse exposition text;
-  * `/healthz` — 200 + `{"run_id", "turn", "uptime_s"}`, the liveness
-    probe: run_id identifies the process, turn proves the engine loop
-    is advancing between polls.
+  * `/healthz` — 200 + `{"run_id", "turn", "uptime_s", "device_kind",
+    "live_bytes", "compile_count"}`, the liveness probe: run_id
+    identifies the process, turn proves the engine loop is advancing
+    between polls, live_bytes/compile_count expose leak and
+    recompile churn without a Prometheus scrape (both read the
+    devstats cache — never a device sync);
+  * `/profile` — GET returns the profile controller's status; POST
+    (optionally `?turns=N`) arms an on-demand jax.profiler capture of
+    the next N engine turns, 409 when no `--profile-dir` was given or
+    a capture is already armed.
 
 Returns the running server object — `.port` tells callers (and the
 obs-smoke harness) where an ephemeral bind landed. The thread is a
@@ -22,9 +29,10 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from gol_tpu.obs import catalog
+from gol_tpu.obs import catalog, devstats
 from gol_tpu.obs import flight as obs_flight
 from gol_tpu.obs.metrics import REGISTRY
+from gol_tpu.obs.prof import PROFILER, ProfileUnavailable
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 JSON_CONTENT_TYPE = "application/json; charset=utf-8"
@@ -36,9 +44,11 @@ _LAST: Optional["MetricsServer"] = None
 
 def healthz_doc() -> dict:
     """The /healthz body (also used by tests without a socket)."""
-    return {"run_id": obs_flight.RUN_ID,
-            "turn": catalog.ENGINE_TURN.value,
-            "uptime_s": round(obs_flight.uptime_s(), 3)}
+    doc = {"run_id": obs_flight.RUN_ID,
+           "turn": catalog.ENGINE_TURN.value,
+           "uptime_s": round(obs_flight.uptime_s(), 3)}
+    doc.update(devstats.healthz_fields())
+    return doc
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -63,8 +73,39 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(
                 json.dumps(healthz_doc(), sort_keys=True).encode("utf-8"),
                 JSON_CONTENT_TYPE)
+        elif path == "/profile":
+            self._reply(
+                json.dumps(PROFILER.status(), sort_keys=True)
+                .encode("utf-8"), JSON_CONTENT_TYPE)
         else:
             self.send_error(404)
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        path, _, query = self.path.partition("?")
+        if path != "/profile":
+            self.send_error(404)
+            return
+        turns = 0
+        for kv in query.split("&"):
+            k, _, v = kv.partition("=")
+            if k == "turns":
+                try:
+                    turns = int(v)
+                except ValueError:
+                    self.send_error(400, "bad turns value")
+                    return
+        try:
+            body = PROFILER.request(turns=turns, source="http")
+        except ProfileUnavailable as e:
+            payload = json.dumps({"error": str(e)}).encode("utf-8")
+            self.send_response(409)
+            self.send_header("Content-Type", JSON_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        self._reply(json.dumps(body, sort_keys=True).encode("utf-8"),
+                    JSON_CONTENT_TYPE)
 
     def log_message(self, fmt, *args):  # silence per-request stderr spam
         pass

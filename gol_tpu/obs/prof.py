@@ -1,0 +1,186 @@
+"""On-demand jax.profiler capture (the Profile wire method / HTTP trigger).
+
+A process that was started with a profile directory (``--profile-dir``
+or ``GOL_PROFILE_DIR``) can be told — at any point, without restart —
+to capture the next N engine turns under ``jax.profiler``.  The request
+side (wire dispatch thread, HTTP handler, keypress) only *arms* the
+controller; the engine run loop is the sole consumer: it drains its
+inflight pipeline, takes the request, and runs the N turns
+synchronously inside :meth:`ProfileController.capture`, which brackets
+them with ``start_trace``/``stop_trace`` and records the artifacts the
+profiler wrote (``*.xplane.pb`` for XProf, ``*.trace.json.gz`` for
+Perfetto — load either next to the span export from obs/trace.py).
+
+Security posture matches RestoreRun: remote peers never choose the
+server's filesystem path.  The capture directory is fixed by whoever
+started the process; a Profile request can only pick the turn count.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from gol_tpu.obs import catalog as _cat
+
+PROFILE_DIR_ENV = "GOL_PROFILE_DIR"
+PROFILE_TURNS_ENV = "GOL_PROFILE_TURNS"
+DEFAULT_TURNS = 256
+
+
+class ProfileUnavailable(RuntimeError):
+    """No profile directory configured, or a capture is already armed."""
+
+
+@dataclass
+class ProfileRequest:
+    turns: int
+    directory: str
+    source: str
+    requested_unix: float = field(default_factory=time.time)
+
+
+def _scan_artifacts(directory: str, since: float) -> List[str]:
+    """Profiler artifacts under `directory` modified at/after `since`."""
+    found = []
+    for pat in ("**/*.xplane.pb", "**/*.trace.json.gz"):
+        for p in glob.glob(os.path.join(directory, pat), recursive=True):
+            try:
+                if os.path.getmtime(p) >= since - 1.0:
+                    found.append(p)
+            except OSError:
+                continue
+    return sorted(found)
+
+
+class ProfileController:
+    """Single-slot arm/take/capture state machine around jax.profiler."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._dir: Optional[str] = None
+        self._pending: Optional[ProfileRequest] = None
+        self._capturing = False
+        self._last: dict = {}
+
+    def configure(self, directory: Optional[str]) -> None:
+        """Set (or clear) the capture directory for this process."""
+        with self._lock:
+            self._dir = os.path.abspath(directory) if directory else None
+
+    @property
+    def directory(self) -> Optional[str]:
+        with self._lock:
+            return self._dir
+
+    def request(self, turns: int = 0, source: str = "wire") -> dict:
+        """Arm a capture of the next `turns` engine turns.
+
+        Raises ProfileUnavailable when no directory is configured or a
+        capture is already pending/running — the caller maps that to a
+        wire/HTTP error rather than silently queueing."""
+        turns = int(turns) if turns else 0
+        if turns <= 0:
+            turns = int(os.environ.get(PROFILE_TURNS_ENV, DEFAULT_TURNS))
+        with self._lock:
+            if self._dir is None:
+                raise ProfileUnavailable(
+                    "no profile directory configured (start with "
+                    "--profile-dir or GOL_PROFILE_DIR)")
+            if self._pending is not None or self._capturing:
+                raise ProfileUnavailable("profile capture already armed")
+            self._pending = ProfileRequest(turns=turns, directory=self._dir,
+                                           source=source)
+        _cat.PROFILE_ARMED.set(1.0)
+        return {"armed": True, "turns": turns, "dir": self._dir}
+
+    def take(self) -> Optional[ProfileRequest]:
+        """Consume the pending request (engine run loop only)."""
+        with self._lock:
+            req, self._pending = self._pending, None
+        return req
+
+    @contextmanager
+    def capture(self, req: ProfileRequest):
+        """Run the engine's capture body under jax.profiler.trace.
+
+        Yields the request; on exit stops the trace, scans for the
+        artifacts jax wrote, and records outcome counters + status."""
+        os.makedirs(req.directory, exist_ok=True)
+        t0 = time.time()
+        with self._lock:
+            self._capturing = True
+        started = False
+        status = "error"
+        try:
+            import jax
+
+            jax.profiler.start_trace(req.directory)
+            started = True
+            yield req
+            status = "ok"
+        finally:
+            err = None
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # never sink the engine loop
+                    err = repr(e)
+                    status = "error"
+            artifacts = _scan_artifacts(req.directory, t0)
+            _cat.PROFILE_CAPTURES.labels(status=status).inc()
+            _cat.PROFILE_ARMED.set(0.0)
+            with self._lock:
+                self._capturing = False
+                self._last = {
+                    "status": status,
+                    "turns": req.turns,
+                    "source": req.source,
+                    "dir": req.directory,
+                    "artifacts": artifacts,
+                    "seconds": round(time.time() - t0, 3),
+                    "finished_unix": int(time.time()),
+                }
+                if err:
+                    self._last["error"] = err
+
+    def status(self) -> dict:
+        """Operator-facing snapshot for the /profile endpoint + Profile."""
+        with self._lock:
+            return {
+                "dir": self._dir,
+                "armed": self._pending is not None or self._capturing,
+                "capturing": self._capturing,
+                "pending_turns": (self._pending.turns
+                                  if self._pending else None),
+                "captures_ok": int(
+                    _cat.PROFILE_CAPTURES.labels(status="ok").value),
+                "captures_error": int(
+                    _cat.PROFILE_CAPTURES.labels(status="error").value),
+                "last": dict(self._last),
+            }
+
+
+PROFILER = ProfileController()
+
+
+def arm_from_env() -> bool:
+    """Configure PROFILER from GOL_PROFILE_DIR and arm one capture.
+
+    Used by one-shot CLI runs: `--profile-dir` exports the env var and
+    the engine captures the first GOL_PROFILE_TURNS turns of the run.
+    Returns True if a capture was armed."""
+    directory = os.environ.get(PROFILE_DIR_ENV)
+    if not directory:
+        return False
+    PROFILER.configure(directory)
+    try:
+        PROFILER.request(source="env")
+    except ProfileUnavailable:
+        return False
+    return True

@@ -214,6 +214,25 @@ class EngineServer:
             elif method == "RestoreRun":
                 turn = self._restore_run(str(header.get("path", "")))
                 send_msg(conn, {"ok": True, "turn": turn})
+            elif method == "Profile":
+                # Arm an on-demand jax.profiler capture of the next N
+                # engine turns, into the server's CONFIGURED directory
+                # (--profile-dir) — same posture as Checkpoint: remote
+                # peers never choose filesystem paths on this host.
+                # turns=0 requests status only.
+                from gol_tpu.obs.prof import PROFILER, ProfileUnavailable
+
+                turns = int(header.get("turns", 0))
+                if turns > 0:
+                    try:
+                        armed = PROFILER.request(turns=turns,
+                                                 source="wire")
+                    except ProfileUnavailable as e:
+                        raise RuntimeError(str(e)) from e
+                    send_msg(conn, {"ok": True, **armed})
+                else:
+                    send_msg(conn, {"ok": True,
+                                    "status": PROFILER.status()})
             elif method == "KillProg":
                 self.engine.kill_prog()
                 send_msg(conn, {"ok": True})
@@ -308,6 +327,11 @@ def main() -> None:
                          "(sets GOL_CKPT_KEEP; default 3; "
                          "GOL_CKPT_KEEP_EVERY additionally pins every "
                          "K-th turn)")
+    ap.add_argument("--profile-dir", metavar="DIR", default="",
+                    help="directory for on-demand jax.profiler captures "
+                         "(Profile wire method / POST /profile arm one; "
+                         "the peer only picks the turn count — this "
+                         "flag fixes where artifacts land)")
     ap.add_argument("--coordinator", metavar="HOST:PORT", default="",
                     help="multi-host engine: jax.distributed coordinator "
                          "address (falls back to GOL_COORDINATOR; unset = "
@@ -337,6 +361,12 @@ def main() -> None:
         os.environ["GOL_CKPT_EVERY_TURNS"] = str(args.ckpt_every)
     if args.ckpt_keep:
         os.environ["GOL_CKPT_KEEP"] = str(args.ckpt_keep)
+    if args.profile_dir:
+        # configure() only: the server arms nothing at startup — a
+        # Profile RPC or POST /profile picks the moment and turn count.
+        from gol_tpu.obs.prof import PROFILER
+
+        PROFILER.configure(args.profile_dir)
     trace.set_process_name("gol-server")
     # Join the multi-host engine cluster FIRST: jax.distributed must
     # initialize before ANYTHING touches the XLA backend (including the

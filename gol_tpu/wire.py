@@ -19,6 +19,13 @@ wire — only names and turns — so the methods stay O(header) regardless
 of board size, and the server refuses path components that escape its
 checkpoint directory ("denied:" error prefix).
 
+Profiling (PR 4): `Profile` {"turns"?} arms an on-demand jax.profiler
+capture of the next N engine turns into the server's configured
+--profile-dir and replies {"armed", "turns", "dir"}; turns<=0 replies
+{"status": ...} (the controller's snapshot) without arming. The peer
+only ever picks the turn count — artifact paths are fixed server-side,
+same containment posture as Checkpoint/RestoreRun.
+
 Trace context: when the sending thread has an open span (obs/trace.py)
 and the header carries no explicit "tc", send_msg stamps the span's
 compact context — `"tc": {"t": <trace_id>, "s": <span_id>}` — into the

@@ -1,0 +1,425 @@
+"""Device-level performance observability (PR 4): devstats compile/
+memory telemetry, on-demand profiler capture, and the perf-regression
+gate — tests mirror docs/OBSERVABILITY.md "Profiling & device
+telemetry".
+
+Process-wide state warning: the compile-signature set and the metric
+registry are process-global (that is their point — recompile churn is
+a process-level signal), so every assertion here is on DELTAS, never
+absolutes.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.engine import Engine
+from gol_tpu.obs import catalog, devstats
+from gol_tpu.obs import prof as obs_prof
+from gol_tpu.params import Params
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import perf_compare  # noqa: E402  (tools/ is not a package)
+
+
+@pytest.fixture(autouse=True)
+def _unconfigure_profiler():
+    """PROFILER is a process singleton: leave no directory or armed
+    request behind for other tests."""
+    yield
+    obs_prof.PROFILER.take()
+    obs_prof.PROFILER.configure(None)
+    catalog.PROFILE_ARMED.set(0.0)
+
+
+def _board(h: int, w: int) -> np.ndarray:
+    world = np.zeros((h, w), np.uint8)
+    world[1, 1:4] = 255  # blinker
+    return world
+
+
+# ------------------------------------------------------------- devstats
+
+
+def test_memory_snapshot_graceful_none_on_cpu():
+    import jax
+
+    # CPU backends report no memory_stats: every layer must degrade to
+    # None rather than raise (the graceful-None contract).
+    assert devstats.memory_snapshot(jax.devices()[0]) is None
+    summary = devstats.poll_device_memory()
+    assert summary["supported"] is False
+    assert summary["live_bytes"] is None
+    assert summary["peak_bytes"] is None
+    assert summary["devices"] == len(jax.local_devices())
+    assert catalog.DEV_MEM_SUPPORTED.value == 0.0
+    assert catalog.DEV_DEVICES.value == float(len(jax.local_devices()))
+
+
+def test_memory_snapshot_reads_backend_stats():
+    class FakeDevice:
+        def memory_stats(self):
+            return {"bytes_in_use": 1024, "peak_bytes_in_use": 4096,
+                    "bytes_limit": 2 ** 30, "num_allocs": 7}
+
+    snap = devstats.memory_snapshot(FakeDevice())
+    assert snap["live_bytes"] == 1024
+    assert snap["peak_bytes"] == 4096
+    assert snap["limit_bytes"] == 2 ** 30
+    assert snap["raw"]["num_allocs"] == 7
+
+
+def test_healthz_fields_never_touch_jax():
+    devstats.poll_device_memory()
+    fields = devstats.healthz_fields()
+    assert set(fields) == {"device_kind", "live_bytes", "compile_count"}
+    assert fields["device_kind"] == "cpu"
+    assert fields["live_bytes"] is None
+    assert fields["compile_count"] == int(catalog.COMPILE_TOTAL.value)
+
+
+def test_healthz_doc_carries_device_fields():
+    from gol_tpu.obs.http import healthz_doc
+
+    devstats.poll_device_memory()
+    doc = healthz_doc()
+    for field in ("run_id", "turn", "uptime_s",
+                  "device_kind", "live_bytes", "compile_count"):
+        assert field in doc, field
+
+
+def test_compile_hooks_count_backend_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    assert devstats.install_compile_hooks()
+    assert devstats.install_compile_hooks()  # idempotent
+    before = catalog.COMPILE_TOTAL.value
+    before_hist = catalog.COMPILE_SECONDS.labels().count
+
+    # A function this process has definitely never compiled (unique
+    # constant baked into the jaxpr), so the backend must compile.
+    salt = time.time_ns() % (2 ** 31)
+    fn = jax.jit(lambda x: x * 2 + salt)
+    fn(jnp.arange(8)).block_until_ready()
+
+    assert catalog.COMPILE_TOTAL.value >= before + 1
+    assert catalog.COMPILE_SECONDS.labels().count >= before_hist + 1
+    # A cache hit (same computation again) must NOT count as a compile.
+    again = catalog.COMPILE_TOTAL.value
+    fn(jnp.arange(8)).block_until_ready()
+    assert catalog.COMPILE_TOTAL.value == again
+
+
+def test_note_signature_once_per_key():
+    before = catalog.COMPILE_STEP_SIGNATURES.value
+    key = ("test-repr", (int(time.time_ns()),), "uint32", (1,), "B3/S23")
+    assert devstats.note_signature(key) is True
+    assert devstats.note_signature(key) is False
+    assert catalog.COMPILE_STEP_SIGNATURES.value == before + 1
+
+
+def test_compiled_cost_normalizes_shapes():
+    class ListCost:
+        def cost_analysis(self):
+            return [{"flops": 128.0, "bytes accessed": 512.0}]
+
+    class DictCost:
+        def cost_analysis(self):
+            return {"flops": 64.0, "bytes_accessed": 256.0}
+
+    class NoCost:
+        def cost_analysis(self):
+            raise NotImplementedError
+
+    assert devstats.compiled_cost(ListCost()) == {
+        "flops": 128.0, "bytes_accessed": 512.0}
+    assert devstats.compiled_cost(DictCost()) == {
+        "flops": 64.0, "bytes_accessed": 256.0}
+    assert devstats.compiled_cost(NoCost()) is None
+
+
+def test_compiled_cost_real_jit():
+    import jax
+    import jax.numpy as jnp
+
+    compiled = jax.jit(lambda x: (x * x).sum()).lower(
+        jnp.arange(64.0)).compile()
+    cost = devstats.compiled_cost(compiled)
+    assert cost is not None and cost["flops"] > 0
+
+
+# --------------------------------------------- recompile detection (engine)
+
+
+def test_recompile_detection_once_per_signature():
+    """Changing board dtype/representation mid-process increments the
+    signature counter exactly once per NEW signature; re-running the
+    same configuration adds nothing."""
+    eng = Engine()
+    # Distinctive sizes so no other test's engine run already noted
+    # these signatures in this process.
+    packed_board = _board(96, 96)    # width % 32 == 0 -> packed uint32
+    u8_board = _board(96, 88)        # width % 32 != 0 -> u8
+
+    before = catalog.COMPILE_STEP_SIGNATURES.value
+    eng.server_distributor(
+        Params(threads=1, image_width=96, image_height=96, turns=2),
+        packed_board)
+    assert catalog.COMPILE_STEP_SIGNATURES.value == before + 1
+
+    # Same representation, shape, mesh, rule again: NOT a new signature.
+    eng.server_distributor(
+        Params(threads=1, image_width=96, image_height=96, turns=2),
+        packed_board)
+    assert catalog.COMPILE_STEP_SIGNATURES.value == before + 1
+
+    # Representation/dtype change (packed uint32 -> u8): exactly one
+    # more.
+    eng.server_distributor(
+        Params(threads=1, image_width=88, image_height=96, turns=2),
+        u8_board)
+    assert catalog.COMPILE_STEP_SIGNATURES.value == before + 2
+
+    eng.server_distributor(
+        Params(threads=1, image_width=88, image_height=96, turns=2),
+        u8_board)
+    assert catalog.COMPILE_STEP_SIGNATURES.value == before + 2
+
+
+# ------------------------------------------------------ profiler capture
+
+
+def test_profile_request_requires_directory():
+    with pytest.raises(obs_prof.ProfileUnavailable):
+        obs_prof.PROFILER.request(turns=8)
+
+
+def test_profile_request_single_slot(tmp_path):
+    obs_prof.PROFILER.configure(str(tmp_path))
+    armed = obs_prof.PROFILER.request(turns=8, source="test")
+    assert armed["armed"] is True and armed["turns"] == 8
+    assert catalog.PROFILE_ARMED.value == 1.0
+    with pytest.raises(obs_prof.ProfileUnavailable):
+        obs_prof.PROFILER.request(turns=8)
+    assert obs_prof.PROFILER.take().turns == 8
+    assert obs_prof.PROFILER.take() is None
+
+
+def test_profile_capture_through_engine(tmp_path):
+    """An armed request makes the next run capture N turns: loadable
+    artifacts appear, the turns are accounted as traced chunks, and
+    the controller records an ok capture."""
+    prof_dir = str(tmp_path / "prof")
+    obs_prof.PROFILER.configure(prof_dir)
+    obs_prof.PROFILER.request(turns=4, source="test")
+    ok_before = catalog.PROFILE_CAPTURES.labels(status="ok").value
+    traced_before = catalog.ENGINE_TRACED_CHUNKS_TOTAL.value
+
+    eng = Engine()
+    out, turn = eng.server_distributor(
+        Params(threads=1, image_width=64, image_height=64, turns=12),
+        _board(64, 64))
+    assert turn == 12
+
+    assert catalog.PROFILE_CAPTURES.labels(status="ok").value \
+        == ok_before + 1
+    assert catalog.ENGINE_TRACED_CHUNKS_TOTAL.value > traced_before
+    assert catalog.PROFILE_ARMED.value == 0.0
+    status = obs_prof.PROFILER.status()
+    assert status["last"]["status"] == "ok"
+    assert status["last"]["turns"] == 4
+    xplanes = glob.glob(os.path.join(prof_dir, "**", "*.xplane.pb"),
+                        recursive=True)
+    assert xplanes, "no xplane artifact written"
+    perfetto = glob.glob(os.path.join(prof_dir, "**", "*.trace.json.gz"),
+                         recursive=True)
+    assert perfetto, "no Perfetto trace written"
+    with gzip.open(perfetto[0]) as f:
+        assert json.load(f)["traceEvents"]
+    assert status["last"]["artifacts"]  # controller saw them too
+
+
+def test_profile_env_contract(tmp_path, monkeypatch):
+    """GOL_PROFILE_DIR/--profile-dir: the engine arms one capture per
+    run start while the env var is set."""
+    prof_dir = str(tmp_path / "envprof")
+    monkeypatch.setenv(obs_prof.PROFILE_DIR_ENV, prof_dir)
+    monkeypatch.setenv(obs_prof.PROFILE_TURNS_ENV, "4")
+    ok_before = catalog.PROFILE_CAPTURES.labels(status="ok").value
+    eng = Engine()
+    eng.server_distributor(
+        Params(threads=1, image_width=64, image_height=64, turns=8),
+        _board(64, 64))
+    assert catalog.PROFILE_CAPTURES.labels(status="ok").value \
+        == ok_before + 1
+    assert glob.glob(os.path.join(prof_dir, "**", "*.xplane.pb"),
+                     recursive=True)
+
+
+def test_profile_http_endpoint(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from gol_tpu.obs.http import start_metrics_server
+
+    srv = start_metrics_server(0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        # Not configured: POST must 409, GET must still serve status.
+        req = urllib.request.Request(base + "/profile", data=b"",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 409
+        obs_prof.PROFILER.configure(str(tmp_path))
+        body = json.loads(urllib.request.urlopen(
+            urllib.request.Request(base + "/profile?turns=16", data=b"",
+                                   method="POST"),
+            timeout=10).read())
+        assert body["armed"] is True and body["turns"] == 16
+        status = json.loads(urllib.request.urlopen(
+            base + "/profile", timeout=10).read())
+        assert status["armed"] is True
+        assert status["pending_turns"] == 16
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------- perf_compare
+
+
+def _write_bench(path, value, metric="cell-updates/sec (512x512 torus)"):
+    with open(path, "w") as f:
+        f.write(json.dumps({"metric": metric, "value": value,
+                            "unit": "cell-updates/s",
+                            "vs_baseline": None, "detail": {}}) + "\n")
+
+
+def test_perf_compare_identical_ok(tmp_path, capsys):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_bench(a, 1.0e12)
+    _write_bench(b, 1.0e12)
+    assert perf_compare.main([a, b]) == 0
+
+
+def test_perf_compare_20pct_drop_fails(tmp_path, capsys):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_bench(a, 1.0e12)
+    _write_bench(b, 0.8e12)
+    assert perf_compare.main([a, b]) == 1
+
+
+def test_perf_compare_noise_floor_and_improvement(tmp_path, capsys):
+    a = str(tmp_path / "a.jsonl")
+    small = str(tmp_path / "small.jsonl")
+    up = str(tmp_path / "up.jsonl")
+    _write_bench(a, 1.0e12)
+    _write_bench(small, 0.97e12)  # -3%: inside the 5% noise floor
+    _write_bench(up, 1.5e12)      # +50%: improvement, never gates
+    assert perf_compare.main([a, small]) == 0
+    assert perf_compare.main([a, up]) == 0
+
+
+def test_perf_compare_no_overlap_exits_2(tmp_path, capsys):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_bench(a, 1.0e12, metric="metric one")
+    _write_bench(b, 1.0e12, metric="metric two")
+    assert perf_compare.main([a, b]) == 2
+
+
+def test_perf_compare_reads_baseline_and_driver_formats(tmp_path,
+                                                        capsys):
+    baseline = str(tmp_path / "BASELINE.json")
+    driver = str(tmp_path / "BENCH_r99.json")
+    line = json.dumps({"metric": "cell-updates/sec (512x512 torus)",
+                       "value": 2.0e12, "unit": "cell-updates/s",
+                       "vs_baseline": None, "detail": {}})
+    with open(baseline, "w") as f:
+        json.dump({"published": {
+            "cell-updates/sec (512x512 torus)":
+                {"value": 2.0e12, "unit": "cell-updates/s"}}}, f)
+    with open(driver, "w") as f:
+        json.dump({"n": 99, "cmd": "bench", "rc": 0,
+                   "tail": line + "\n", "parsed": json.loads(line)}, f)
+    assert perf_compare.main([baseline, driver]) == 0
+
+
+def test_perf_compare_run_report_derived_metrics(tmp_path, capsys):
+    report = str(tmp_path / "run.jsonl")
+    recs = [{"schema": "gol-run-report/1", "event": "chunk",
+             "cups": 1.0e9, "turns_per_s": 1000.0}] * 3
+    with open(report, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    metrics = perf_compare.load_metrics(report)
+    assert metrics["engine median cups"][0] == 1.0e9
+    assert metrics["engine median turns/sec"][0] == 1000.0
+
+
+def test_committed_baseline_parses():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    metrics = perf_compare.load_metrics(
+        os.path.join(repo, "BASELINE.json"))
+    assert "cell-updates/sec (512x512 torus)" in metrics
+
+
+# ------------------------------------------------------- wire method (e2e)
+
+
+@pytest.mark.timeout(300)
+def test_profile_wire_method_e2e(tmp_path):
+    """Profile over the real wire: status when idle, arm during a live
+    run, artifacts land in the SERVER's configured directory."""
+    from gol_tpu.client import RemoteEngine
+    from tests.server_harness import spawn_server, wait_port
+
+    prof_dir = str(tmp_path / "prof")
+    proc = spawn_server(0, tmp_path,
+                        extra_args=("--profile-dir", prof_dir))
+    try:
+        port = wait_port(proc)
+        assert port, "server never announced its port"
+        eng = RemoteEngine(f"127.0.0.1:{port}", timeout=60.0)
+
+        status = eng.profile()  # turns=0: status, not arming
+        assert status["status"]["dir"] == os.path.abspath(prof_dir)
+        assert status["status"]["armed"] is False
+
+        armed = eng.profile(4)
+        assert armed["armed"] is True and armed["turns"] == 4
+        # Double-arm must be refused while the first is pending.
+        with pytest.raises(RuntimeError):
+            eng.profile(4)
+
+        done = {}
+
+        def run():
+            done["result"] = eng.server_distributor(
+                Params(threads=1, image_width=64, image_height=64,
+                       turns=16), _board(64, 64))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=240)
+        assert not t.is_alive(), "run RPC hung"
+        assert done["result"][1] == 16
+
+        status = eng.profile()
+        assert status["status"]["last"]["status"] == "ok"
+        assert glob.glob(os.path.join(prof_dir, "**", "*.xplane.pb"),
+                         recursive=True)
+        eng.kill_prog()
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)

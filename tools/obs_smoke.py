@@ -12,7 +12,15 @@ Runs a tiny board through the real CLI with `--run-report`,
     controller.run / engine.run / engine.chunk spans share one trace id
     with correct parent links;
   * every metric family in the registry matches the Prometheus naming
-    regex and carries the gol_ prefix.
+    regex and carries the gol_ prefix;
+  * `--profile-dir` produces loadable jax.profiler artifacts (an
+    .xplane.pb plus a Perfetto trace.json.gz that parses), and the
+    gol_profile_*/gol_dev_*/gol_compile_* families show up in
+    /metrics with the capture counted;
+  * `/healthz` carries the device-telemetry fields (device_kind,
+    live_bytes, compile_count) and `/profile` serves capture status;
+  * tools/perf_compare.py round-trips: exit 0 on identical synthetic
+    reports, nonzero on an injected 20% CUPS drop.
 
 Runs IN-PROCESS (main() is called, not subprocessed) so the ephemeral
 metrics port is discoverable without output scraping, and stays inside
@@ -45,13 +53,19 @@ def main() -> int:
     tmpdir = tempfile.mkdtemp(prefix="gol_obs_smoke_")
     report = os.path.join(tmpdir, "run.jsonl")
     spans_path = os.path.join(tmpdir, "spans.json")
+    profile_dir = os.path.join(tmpdir, "profile")
 
     from gol_tpu.main import main as gol_main
 
+    # --profile-turns well under the run length: the capture consumes
+    # its turns as traced chunks, and untraced chunk records must
+    # remain for the report checks below.
     rc = gol_main(["-w", "64", "-h", "64", "--turns", "64",
                    "--rle", "rpentomino", "--headless", "-t", "1",
                    "--run-report", report, "--metrics-port", "0",
-                   "--trace-spans", spans_path])
+                   "--trace-spans", spans_path,
+                   "--profile-dir", profile_dir,
+                   "--profile-turns", "8"])
     if rc != 0:
         print(f"obs-smoke: CLI run failed rc={rc}", file=sys.stderr)
         return 1
@@ -85,9 +99,23 @@ def main() -> int:
                        "# TYPE gol_engine_cups gauge",
                        "# TYPE gol_server_requests_total counter",
                        "# TYPE gol_wire_bytes_total counter",
-                       "gol_engine_chunk_seconds_bucket"):
+                       "gol_engine_chunk_seconds_bucket",
+                       # PR 4 device/compile/profiler families
+                       "# TYPE gol_dev_live_bytes gauge",
+                       "# TYPE gol_dev_peak_bytes gauge",
+                       "# TYPE gol_dev_mem_supported gauge",
+                       "# TYPE gol_dev_devices gauge",
+                       "# TYPE gol_compile_total counter",
+                       "# TYPE gol_compile_cache_hits_total counter",
+                       "# TYPE gol_compile_cache_misses_total counter",
+                       "# TYPE gol_compile_seconds histogram",
+                       "# TYPE gol_compile_step_signatures_total counter",
+                       "# TYPE gol_profile_captures_total counter",
+                       "# TYPE gol_profile_armed gauge"):
             if needle not in body:
                 problems.append(f"/metrics missing {needle!r}")
+        if 'gol_profile_captures_total{status="ok"} 1' not in body:
+            problems.append("profile capture not counted in /metrics")
         for line in body.splitlines():
             if line.startswith("gol_engine_turn "):
                 if float(line.split()[-1]) != 64:
@@ -95,7 +123,63 @@ def main() -> int:
                 break
         else:
             problems.append("no gol_engine_turn sample")
+        base_url = srv.url.rsplit("/", 1)[0]
+        healthz = json.loads(urllib.request.urlopen(
+            base_url + "/healthz", timeout=10).read().decode())
+        for field in ("device_kind", "live_bytes", "compile_count"):
+            if field not in healthz:
+                problems.append(f"/healthz missing {field!r}")
+        if healthz.get("device_kind") != "cpu":
+            problems.append(f"/healthz device_kind: {healthz!r}")
+        prof_status = json.loads(urllib.request.urlopen(
+            base_url + "/profile", timeout=10).read().decode())
+        if prof_status.get("captures_ok") != 1 \
+                or prof_status.get("last", {}).get("status") != "ok":
+            problems.append(f"/profile status: {prof_status!r}")
         srv.close()
+
+    # ---- profiler artifacts -------------------------------------------
+    import glob
+    import gzip
+
+    xplanes = glob.glob(os.path.join(profile_dir, "**", "*.xplane.pb"),
+                        recursive=True)
+    perfetto = glob.glob(os.path.join(profile_dir, "**",
+                                      "*.trace.json.gz"), recursive=True)
+    if not xplanes:
+        problems.append("no .xplane.pb profiler artifact")
+    if not perfetto:
+        problems.append("no Perfetto trace.json.gz profiler artifact")
+    else:
+        try:
+            with gzip.open(perfetto[0]) as f:
+                tdoc = json.load(f)
+            if not tdoc.get("traceEvents"):
+                problems.append("Perfetto trace has no traceEvents")
+        except (OSError, ValueError) as e:
+            problems.append(f"Perfetto trace unloadable: {e}")
+
+    # ---- perf_compare round-trip --------------------------------------
+    import perf_compare
+
+    def _bench_line(value):
+        return json.dumps({"metric": "cell-updates/sec (smoke torus)",
+                           "value": value, "unit": "cell-updates/s",
+                           "vs_baseline": None, "detail": {}})
+
+    same_a = os.path.join(tmpdir, "bench_a.jsonl")
+    same_b = os.path.join(tmpdir, "bench_b.jsonl")
+    dropped = os.path.join(tmpdir, "bench_drop.jsonl")
+    with open(same_a, "w") as f:
+        f.write(_bench_line(1.0e12) + "\n")
+    with open(same_b, "w") as f:
+        f.write(_bench_line(1.0e12) + "\n")
+    with open(dropped, "w") as f:
+        f.write(_bench_line(0.8e12) + "\n")
+    if perf_compare.main([same_a, same_b]) != 0:
+        problems.append("perf_compare: identical reports did not pass")
+    if perf_compare.main([same_a, dropped]) == 0:
+        problems.append("perf_compare: 20% CUPS drop did not fail")
 
     # ---- span export ---------------------------------------------------
     from gol_tpu.obs import trace

@@ -1,0 +1,274 @@
+#!/usr/bin/env python3
+"""Compare BENCH / run-report artifacts and gate on regressions.
+
+    python tools/perf_compare.py BASELINE CANDIDATE [MORE...] [options]
+
+The FIRST file is the baseline; every later file is compared against
+it metric-by-metric. Accepted formats (auto-detected per file, no
+flags needed — these are every perf artifact this repo produces):
+
+  * bench.py stdout — one JSON object per line:
+      {"metric", "value", "unit", "vs_baseline", "detail"}
+  * driver BENCH_r0N.json — {"n", "cmd", "rc", "tail": "<those same
+      lines as one string>", "parsed": <last line>}
+  * gol-run-report/1 JSON-lines — `bench_leg` records carry
+      metric/value/unit; plain engine reports contribute derived
+      metrics (cups / turns_per_s medians over untraced chunks)
+  * BASELINE.json — committed gate anchor: {"published":
+      {metric: value | {"value": ..., "unit": ...}}}
+
+Delta semantics: rate metrics (unit ending "/s", or "/sec" in the
+name) are higher-is-better; "seconds"/"s"-unit metrics are
+lower-is-better. Deltas inside the noise floor (default 5%) are
+reported but never gate. A regression beyond --max-regression
+(default 10%) on any GATED metric (those matching --gate-pattern,
+default "cell-updates|turns/sec|cups") fails the run.
+
+Exit codes: 0 = no gated regression; 1 = gated regression;
+2 = usage / no comparable metric overlap.
+
+`make perf-gate` runs this against the committed BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Dict, Optional, Tuple
+
+# metric -> (value, unit-or-None)
+Metrics = Dict[str, Tuple[float, Optional[str]]]
+
+DEFAULT_NOISE_FLOOR = 5.0
+DEFAULT_MAX_REGRESSION = 10.0
+DEFAULT_GATE_PATTERN = r"cell-updates|turns/sec|cups"
+
+
+def _add(metrics: Metrics, metric, value, unit=None) -> None:
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return
+    metrics[str(metric)] = (value, unit)
+
+
+def _from_bench_lines(text: str, metrics: Metrics) -> None:
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            _add(metrics, rec["metric"], rec["value"], rec.get("unit"))
+
+
+def _from_run_report(records, metrics: Metrics) -> None:
+    cups, rates = [], []
+    for rec in records:
+        event = rec.get("event")
+        if event == "bench_leg" and "metric" in rec:
+            _add(metrics, rec["metric"], rec.get("value"),
+                 rec.get("unit"))
+        elif event == "chunk":
+            if rec.get("cups"):
+                cups.append(float(rec["cups"]))
+            if rec.get("turns_per_s"):
+                rates.append(float(rec["turns_per_s"]))
+    # Engine-report derived metrics: medians over untraced chunks (the
+    # report schema already excludes traced chunks from these fields).
+    if cups:
+        _add(metrics, "engine median cups", statistics.median(cups),
+             "cell-updates/s")
+    if rates:
+        _add(metrics, "engine median turns/sec",
+             statistics.median(rates), "turns/s")
+
+
+def load_metrics(path: str) -> Metrics:
+    """Parse one artifact into {metric: (value, unit)}."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    metrics: Metrics = {}
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        pass
+    if isinstance(doc, dict) and "published" in doc:  # BASELINE.json
+        for metric, val in (doc.get("published") or {}).items():
+            if isinstance(val, dict):
+                _add(metrics, metric, val.get("value"), val.get("unit"))
+            else:
+                _add(metrics, metric, val)
+        return metrics
+    if isinstance(doc, dict) and "tail" in doc:  # driver BENCH_r0N.json
+        _from_bench_lines(str(doc.get("tail") or ""), metrics)
+        return metrics
+    # JSON-lines: a run report (schema field) or raw bench stdout.
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            continue
+    if any(str(r.get("schema", "")).startswith("gol-run-report")
+           for r in records):
+        _from_run_report(records, metrics)
+        # bench_leg-free reports may still carry bench-format lines
+        # (a concatenated artifact); fall through only if empty.
+        if metrics:
+            return metrics
+    _from_bench_lines(text, metrics)
+    return metrics
+
+
+def _higher_is_better(metric: str, unit: Optional[str]) -> bool:
+    if unit and (unit.endswith("/s") or unit.endswith("/sec")):
+        return True
+    if "/sec" in metric or "/s " in metric or "cups" in metric.lower():
+        return True
+    if unit in ("s", "seconds") or "seconds" in metric:
+        return False
+    return True  # throughput-flavoured by default
+
+
+def compare(baseline: Metrics, candidate: Metrics,
+            noise_floor: float, max_regression: float,
+            gate_re) -> Tuple[list, int]:
+    """Rows + worst gated regression pct for one candidate file."""
+    rows = []
+    worst = 0.0
+    for metric in sorted(baseline):
+        if metric not in candidate:
+            continue
+        base_v, base_u = baseline[metric]
+        cand_v, cand_u = candidate[metric]
+        unit = cand_u or base_u
+        if base_v == 0:
+            continue
+        hib = _higher_is_better(metric, unit)
+        delta_pct = (cand_v - base_v) / abs(base_v) * 100.0
+        # regression_pct: how far the candidate moved in the BAD
+        # direction, as a positive number.
+        regression_pct = -delta_pct if hib else delta_pct
+        gated = bool(gate_re.search(metric))
+        verdict = "ok"
+        if abs(delta_pct) < noise_floor:
+            verdict = "noise"
+        elif regression_pct > 0:
+            verdict = "regression"
+        else:
+            verdict = "improvement"
+        fails = (gated and verdict == "regression"
+                 and regression_pct > max_regression)
+        if fails:
+            verdict = "FAIL"
+            worst = max(worst, regression_pct)
+        rows.append({
+            "metric": metric, "unit": unit,
+            "baseline": base_v, "candidate": cand_v,
+            "delta_pct": round(delta_pct, 2),
+            "higher_is_better": hib, "gated": gated,
+            "verdict": verdict,
+        })
+    return rows, worst
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare BENCH/run-report artifacts; gate on "
+                    "regressions (first file = baseline)")
+    ap.add_argument("files", nargs="+", metavar="FILE",
+                    help="baseline first, then one or more candidates")
+    ap.add_argument("--noise-floor", type=float,
+                    default=DEFAULT_NOISE_FLOOR, metavar="PCT",
+                    help="ignore deltas smaller than PCT%% (default 5)")
+    ap.add_argument("--max-regression", type=float,
+                    default=DEFAULT_MAX_REGRESSION, metavar="PCT",
+                    help="fail on gated metrics regressing more than "
+                         "PCT%% (default 10)")
+    ap.add_argument("--gate-pattern", default=DEFAULT_GATE_PATTERN,
+                    metavar="REGEX",
+                    help="metrics that can fail the gate (default "
+                         "%(default)r); others are report-only")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object "
+                         "instead of the table")
+    args = ap.parse_args(argv)
+    if len(args.files) < 2:
+        ap.error("need a baseline and at least one candidate file")
+    try:
+        gate_re = re.compile(args.gate_pattern)
+    except re.error as e:
+        ap.error(f"bad --gate-pattern: {e}")
+
+    try:
+        baseline = load_metrics(args.files[0])
+    except OSError as e:
+        print(f"perf_compare: cannot read baseline: {e}",
+              file=sys.stderr)
+        return 2
+    if not baseline:
+        print(f"perf_compare: no metrics found in baseline "
+              f"{args.files[0]}", file=sys.stderr)
+        return 2
+
+    failed = False
+    any_overlap = False
+    report = {"baseline": args.files[0], "candidates": []}
+    for path in args.files[1:]:
+        try:
+            candidate = load_metrics(path)
+        except OSError as e:
+            print(f"perf_compare: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        rows, worst = compare(baseline, candidate, args.noise_floor,
+                              args.max_regression, gate_re)
+        if rows:
+            any_overlap = True
+        if worst > 0:
+            failed = True
+        report["candidates"].append(
+            {"file": path, "rows": rows,
+             "worst_gated_regression_pct": round(worst, 2)})
+        if not args.json:
+            print(f"== {os.path.basename(args.files[0])} -> "
+                  f"{os.path.basename(path)}")
+            if not rows:
+                print("  (no comparable metrics)")
+            width = max((len(r["metric"]) for r in rows), default=0)
+            for r in rows:
+                gate = "gated" if r["gated"] else "     "
+                print(f"  {r['metric']:<{width}}  "
+                      f"{r['baseline']:>14.6g} -> "
+                      f"{r['candidate']:>14.6g}  "
+                      f"{r['delta_pct']:>+8.2f}%  {gate}  "
+                      f"{r['verdict']}")
+    if not any_overlap:
+        print("perf_compare: no metric overlap between baseline and "
+              "any candidate", file=sys.stderr)
+        return 2
+    report["ok"] = not failed
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    elif failed:
+        print("perf-gate: FAIL (regression beyond "
+              f"{args.max_regression:g}% on a gated metric)")
+    else:
+        print("perf-gate: ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
