@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -19,6 +19,12 @@ obs-smoke:
 # payloads, prove retention safety (tools/ckpt_smoke.py).
 ckpt-smoke:
 	JAX_PLATFORMS=cpu python tools/ckpt_smoke.py
+
+# End-to-end wire data-plane check, CPU-only: loopback server/client
+# capability handshake, packed + zlib + xrle codec round-trips, raw-u8
+# old-peer fallback, host/device bitpack parity (tools/wire_smoke.py).
+wire-smoke:
+	JAX_PLATFORMS=cpu python tools/wire_smoke.py
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -30,11 +30,12 @@ workload, not a trick.
 
 Usage:
     python bench.py                # full matrix: 5120², 65536², sparse,
-                                   # engine stack, then the 512²
-                                   # north-star line LAST
+                                   # engine stack, wire data plane, then
+                                   # the 512² north-star line LAST
     python bench.py --size 5120    # one dense config
     python bench.py --pattern rpentomino
     python bench.py --engine       # full-engine-stack 512² sustained run
+    python bench.py --wire         # loopback snapshot throughput
 """
 
 from __future__ import annotations
@@ -590,6 +591,86 @@ def bench_ksweep(n: int) -> int:
     return 0
 
 
+def bench_wire(n: int, reps: int = 0) -> int:
+    """Snapshot data-plane leg: an in-process EngineServer and a
+    RemoteEngine on a 127.0.0.1 TCP socket, timing repeated GetWorld
+    round-trips of an n² board through the negotiated codec stack
+    (packed device frames, banded device→socket streaming,
+    gol_tpu/wire.py). Reports decoded-board MB/s — the rate a live-view
+    or state-pull consumer experiences end to end (device fetch +
+    encode + loopback + decode) — with the actual on-wire payload bytes
+    per codec in detail. Parity gate: every decoded snapshot must be
+    bit-identical to the uploaded board."""
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import Engine
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.params import Params
+    from gol_tpu.server import EngineServer
+
+    try:
+        # Blockwise in-place threshold: the flagship 131072² board is
+        # 17 GB of pixels, so no full-board float or bool intermediates.
+        rng = np.random.default_rng(0)
+        world = rng.integers(0, 256, size=(n, n), dtype=np.uint8)
+        for i in range(0, n, 4096):
+            blk = world[i:i + 4096]
+            blk[:] = np.where(blk < 64, np.uint8(255), np.uint8(0))
+    except MemoryError:
+        print(f"BENCH LEG SKIPPED (wire {n}): host RAM too small for an "
+              f"{n}x{n} pixel board", file=sys.stderr)
+        return 0
+    if not reps:
+        # ~2 GB of decoded board per leg, floor 3 so the timing is never
+        # a single sample, cap 256 so the 512² leg (RPC-latency-bound)
+        # stays inside the time budget.
+        reps = min(256, max(3, int(2e9) // (n * n)))
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    try:
+        cli = RemoteEngine(f"127.0.0.1:{srv.port}")
+        p = Params(threads=1, image_width=n, image_height=n, turns=0)
+        cli.server_distributor(p, world)
+        got, _ = cli.get_world()  # warm: snapshot path compiled + staged
+        parity = bool(np.array_equal(got, world))
+        del got
+        f0 = {c: obs_cat.WIRE_FRAME_BYTES.labels(codec=c).value
+              for c in obs_cat.WIRE_CODECS}
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            got, _ = cli.get_world()
+        elapsed = time.perf_counter() - t0
+        parity = parity and bool(np.array_equal(got, world))
+        payload = {c: int(obs_cat.WIRE_FRAME_BYTES.labels(codec=c).value
+                          - f0[c])
+                   for c in obs_cat.WIRE_CODECS}
+        payload = {c: v for c, v in payload.items() if v}
+        caps = sorted(cli.peer_caps)
+    except MemoryError:
+        print(f"BENCH LEG SKIPPED (wire {n}): host RAM too small to "
+              f"decode an {n}x{n} snapshot", file=sys.stderr)
+        return 0
+    finally:
+        srv.shutdown()
+    if parity is False:
+        print(f"PARITY FAIL (wire {n}x{n}): decoded snapshot != "
+              f"uploaded board", file=sys.stderr)
+    raw_bytes = n * n * reps
+    wire_bytes = sum(payload.values())
+    _emit(
+        f"snapshot MB/s ({n}x{n} loopback)",
+        round(raw_bytes / 1e6 / elapsed, 1), "MB/s", None,
+        {"size": n, "reps": reps, "elapsed_s": round(elapsed, 4),
+         "caps": caps, "codec_payload_bytes": payload,
+         "payload_bytes_per_snapshot": wire_bytes // max(reps, 1),
+         "wire_vs_raw": round(wire_bytes / raw_bytes, 4) if raw_bytes
+         else None,
+         "alive_parity": parity,
+         "parity_check": "decoded snapshot vs uploaded board, "
+                         "bit-identical"},
+    )
+    return 0 if parity is not False else 1
+
+
 # Sized so the steady-state regime dominates the one-off chunk ramp
 # ~10x (the reference's default run is 10^10 turns, `Local/main.go:37` —
 # long runs are the honest interactive workload).
@@ -724,6 +805,10 @@ def main() -> int:
     ap.add_argument("--gen-rule", default="/2/3", metavar="RULE",
                     help="rule for the --gen leg: any 3- or 4-state "
                          "rulestring (default /2/3; 345/2/4 = Star Wars)")
+    ap.add_argument("--wire", action="store_true",
+                    help="run the loopback snapshot data-plane leg(s) "
+                         "only (server+client wire stack; --size for "
+                         "one board, else 512/8192/131072)")
     ap.add_argument("--ksweep", action="store_true",
                     help="two-point K-sweep for --size: marginal "
                          "per-turn cost + asymptotic cups + roofline")
@@ -804,6 +889,21 @@ def main() -> int:
 
 
 def _dispatch(args, ap) -> int:
+    if args.wire:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep:
+            ap.error("--wire is its own config; combine only with --size")
+        rc = 0
+        for n in ((args.size,) if args.size is not None
+                  else (512, 8192, 131072)):
+            try:
+                rc |= bench_wire(n)
+            except Exception as e:
+                print(f"BENCH LEG FAILED (bench_wire({n},)): "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                rc |= 1
+        return rc
+
     if args.ksweep:
         if args.size is None or args.pattern != "dense" or args.gen \
                 or args.engine:
@@ -870,6 +970,10 @@ def _dispatch(args, ap) -> int:
         rc |= leg(bench_dense, n, default_turns(n), args.warmup_turns)
     rc |= leg(bench_sparse, SPARSE_TURNS)
     rc |= leg(bench_engine)
+    # Wire data-plane legs (the 131072² wire line runs under --wire on
+    # hosts with the RAM for two full pixel boards).
+    for n in (512, 8192):
+        rc |= leg(bench_wire, n)
     rc |= leg(bench_dense, 512, default_turns(512), args.warmup_turns)
     return rc
 

@@ -34,6 +34,7 @@ from gol_tpu.obs import trace
 from gol_tpu.obs.log import log as obs_log
 from gol_tpu.params import Params
 from gol_tpu.utils.envcfg import env_float, env_int
+from gol_tpu import wire
 from gol_tpu.wire import recv_msg, send_msg
 
 HB_INTERVAL_ENV = "GOL_HB_INTERVAL"   # seconds between pings; 0 disables
@@ -73,9 +74,30 @@ class RemoteEngine:
         # orphaned run after a transient partition without being able to
         # touch a different controller's run.
         self._token = uuid.uuid4().hex
+        # Wire caps the server advertised in its last reply (empty until
+        # the first RPC lands — the distributor always pings before any
+        # board moves, so uploads negotiate in practice). The token
+        # doubles as the GetView "vkey" the server's delta cache is
+        # keyed by; `_view_basis` is the view frame we already hold.
+        self._peer_caps: frozenset = frozenset()
+        self._view_basis = None  # (turn, fy, fx, pixels)
 
-    def _call(self, header: dict, world=None, timeout=None):
+    @property
+    def peer_caps(self) -> frozenset:
+        """Codecs the server advertised (intersected with our
+        SUPPORTED_CAPS); empty until a reply has been seen, or against
+        a pre-caps server — which then only ever receives raw u8."""
+        return self._peer_caps
+
+    def _note_caps(self, resp) -> None:
+        if isinstance(resp, dict) and isinstance(resp.get("caps"), list):
+            self._peer_caps = wire.SUPPORTED_CAPS & frozenset(
+                c for c in resp["caps"] if isinstance(c, str))
+
+    def _call(self, header: dict, world=None, timeout=None,
+              xrle_basis=None):
         label = obs.method_label(str(header.get("method")))
+        header.setdefault("caps", sorted(wire.local_caps()))
         obs.CLIENT_REQUESTS.labels(method=label).inc()
         t0 = time.monotonic()
         # The span sits on this thread's context stack while send_msg
@@ -86,9 +108,11 @@ class RemoteEngine:
                 sock = socket.create_connection(
                     self._addr, timeout=self._timeout)
                 try:
+                    wire.enable_nodelay(sock)
                     sock.settimeout(timeout)  # None → block (long run call)
                     send_msg(sock, header, world)
-                    resp, resp_world = recv_msg(sock)
+                    resp, resp_world = recv_msg(sock,
+                                                xrle_basis=xrle_basis)
                 finally:
                     sock.close()
             except (ConnectionError, OSError):
@@ -97,6 +121,7 @@ class RemoteEngine:
             finally:
                 obs.CLIENT_REQUEST_SECONDS.labels(method=label).observe(
                     time.monotonic() - t0)
+        self._note_caps(resp)
         _check_resp(resp)
         return resp, resp_world
 
@@ -120,11 +145,13 @@ class RemoteEngine:
             "sub_workers": list(sub_workers),
             "start_turn": start_turn,
             "token": self._token,
+            "caps": sorted(wire.local_caps()),
         }
         hb_interval = env_float(HB_INTERVAL_ENV, HB_INTERVAL_DEFAULT)
         hb_misses = env_int(HB_MISSES_ENV, HB_MISSES_DEFAULT)
 
         sock = socket.create_connection(self._addr, timeout=self._timeout)
+        wire.enable_nodelay(sock)
         # The run socket is idle for the whole (possibly multi-hour) run;
         # without keepalive a NAT/firewall can evict the flow while fresh
         # ping connections keep succeeding — a hang the watchdog can't see.
@@ -184,7 +211,16 @@ class RemoteEngine:
             # nothing watching.
             if hb_interval > 0:
                 threading.Thread(target=watchdog, daemon=True).start()
-            send_msg(sock, header, world)
+            frame = None
+            if world is not None and self._peer_caps:
+                # The server advertised caps on an earlier reply (the
+                # distributor's attach ping at the latest), so the seed
+                # board uploads through the same codec stack snapshots
+                # come back on — a packed board puts 8× fewer bytes up.
+                frame = wire.encode_board(
+                    world, self._peer_caps & wire.local_caps())
+                world = None
+            send_msg(sock, header, world, frame=frame)
             resp, out = recv_msg(sock)
         except (ConnectionError, OSError) as e:
             obs.CLIENT_ERRORS.labels(method="ServerDistributor").inc()
@@ -203,6 +239,7 @@ class RemoteEngine:
                 sock.close()
             except OSError:
                 pass
+        self._note_caps(resp)
         _check_resp(resp)
         return out, int(resp["turn"])
 
@@ -243,11 +280,27 @@ class RemoteEngine:
     def get_view(self, max_cells: int):
         """(view pixels, turn, (fy, fx)) — the full board (dense) or
         live window (sparse) when it fits max_cells, else a server-side
-        downsampled frame whose transfer is O(max_cells)."""
-        resp, view = self._call(
-            {"method": "GetView", "max_cells": int(max_cells)},
-            timeout=self._timeout)
-        return view, int(resp["turn"]), (int(resp["fy"]), int(resp["fx"]))
+        downsampled frame whose transfer is O(max_cells).
+
+        Declares the frame it already holds ("vkey" + "basis_turn") so
+        an xrle-capable server can reply with an XOR-delta instead of
+        the whole frame — consecutive live-view polls of a GoL board
+        are nearly identical, so steady-state polling costs O(changed
+        cells), not O(view)."""
+        header = {"method": "GetView", "max_cells": int(max_cells),
+                  "vkey": self._token}
+        xb = None
+        basis = self._view_basis
+        if basis is not None and wire.CAP_XRLE in self._peer_caps:
+            header["basis_turn"] = basis[0]
+            xb = (basis[0], basis[3])
+        resp, view = self._call(header, timeout=self._timeout,
+                                xrle_basis=xb)
+        turn = int(resp["turn"])
+        fy, fx = int(resp["fy"]), int(resp["fx"])
+        if view is not None:
+            self._view_basis = (turn, fy, fx, view)
+        return view, turn, (fy, fx)
 
     def get_window(self):
         """Sparse engines: (window pixels, (ox, oy) torus origin, turn)."""

@@ -32,6 +32,7 @@ from gol_tpu.engine import (
 )
 from gol_tpu.io.pgm import input_path, output_path, read_pgm, write_pgm
 from gol_tpu.obs import trace as obs_trace
+from gol_tpu.obs.log import log as obs_log
 from gol_tpu.params import Params
 from gol_tpu.utils.cell import alive_cells_from_board
 from gol_tpu.utils.envcfg import env_float, env_int
@@ -471,6 +472,21 @@ def distributor(
                     f"{src}: image is {world.shape[1]}x{world.shape[0]} "
                     f"but Params say {width}x{height}")
             turns_left = p.turns
+
+        if getattr(engine, "recoverable", False):
+            # Attach probe: one ping teaches the client the server's
+            # wire caps BEFORE the seed board is uploaded, so even the
+            # first upload rides the negotiated codec path (packed
+            # boards put 8× fewer bytes up). Failures fall through to
+            # the submit loop's own retry/recovery story.
+            try:
+                engine.ping()
+                caps = getattr(engine, "peer_caps", None)
+                if caps:
+                    obs_log("wire.caps", caps=sorted(caps))
+            except (ConnectionError, OSError, EngineKilled,
+                    RuntimeError):
+                pass
 
         events_q.put(ev.StateChange(start_turn, ev.State.EXECUTING))
 

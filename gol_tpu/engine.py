@@ -168,6 +168,34 @@ def _board_width(cells, repr_: str) -> int:
     return w * 32 if repr_ in ("packed", "gen3") else w
 
 
+def _banded_device_rows(cells, h: int, row_nbytes: int):
+    """Yield the first `h` rows of a device array as host numpy bands of
+    roughly GOL_WIRE_BAND_BYTES each, with the device→host copy of band
+    i+1 started (`copy_to_host_async`) before band i is yielded — while
+    the consumer pushes band i into a socket, the next band is already
+    in flight off the device, and no full-board host copy (the old
+    `np.ascontiguousarray` transient) ever exists. Boards that fit one
+    band degrade to a single device_get."""
+    from gol_tpu import wire
+
+    band_rows = max(1, wire.band_bytes() // max(1, row_nbytes))
+    slices = [cells[r0:min(r0 + band_rows, h)]
+              for r0 in range(0, h, band_rows)]
+
+    def _stage(x) -> None:
+        try:
+            x.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # plain numpy fallback arrays / backends without it
+
+    if slices:
+        _stage(slices[0])
+    for i, s in enumerate(slices):
+        if i + 1 < len(slices):
+            _stage(slices[i + 1])
+        yield np.asarray(jax.device_get(s))
+
+
 @functools.lru_cache(maxsize=64)
 def _padded_row_counts(repr_: str, pad: int):
     """Cached jit fusing extension-crop + per-row firing count into ONE
@@ -1224,6 +1252,54 @@ class Engine(ControlFlagProtocol):
         """({0,255} board snapshot, completed turn) (ref `Server:62-67`)."""
         self._check_alive()
         return self._snapshot()
+
+    # Dense views/snapshots are board-anchored: two frames of the same
+    # shape from the same run are always comparable, so the wire layer
+    # may delta-encode them (contrast SparseEngine, whose frames are
+    # window-anchored and drift with the pattern).
+    frames_diffable = True
+
+    @property
+    def binary_pixels(self) -> bool:
+        """True iff snapshots materialize as strict {0,255} pixels — the
+        precondition for the wire's bit-packed codec. Generations boards
+        carry gray levels and must never be packed."""
+        return not isinstance(self._rule, GenerationsRule)
+
+    def get_world_frame(self, caps) -> Tuple["object", int]:
+        """(wire.Frame, completed turn): the codec-framed snapshot path
+        (PR 5). The packed representation ships its device-resident
+        words directly — no device-side unpack, 8× fewer wire bytes —
+        and large boards stream as row bands whose device→host copy
+        overlaps the caller's socket send (`_banded_device_rows`).
+        Generations reprs fall back to pixel materialization (their
+        gray levels aren't packable); all paths honour the negotiated
+        `caps` and degrade to raw u8 for caps-less peers."""
+        from gol_tpu import wire
+
+        self._check_alive()
+        with self._state_lock:
+            cells, turn, repr_ = self._cells, self._turn, self._repr
+            pad = self._pad_rows
+        if cells is None:
+            raise RuntimeError("no board loaded")
+        caps = frozenset(caps)
+        h = cells.shape[-2] - pad
+        w = _board_width(cells, repr_)
+        if repr_ == "packed":
+            body = cells[:h] if pad else cells
+            bands = _banded_device_rows(body, h, cells.shape[-1] * 4)
+            return wire.packed_words_frame(h, w, bands, caps), turn
+        if repr_ == "u8":
+            body = cells[:h] if pad else cells
+            bands = _banded_device_rows(body, h, w)
+            # Bands come off the device as {0,1} cells; the frame
+            # builder packs them as-is or scales to pixels per band —
+            # either way the full-board to_pixels dispatch is gone.
+            return wire.u8_band_frame(h, w, bands, caps, binary=True,
+                                      values01=True), turn
+        px = self._materialize(cells, repr_, pad)
+        return wire.encode_board(px, caps, binary=False), turn
 
     def get_view(
         self, max_cells: int

@@ -82,6 +82,45 @@ for _d in ("sent", "received"):
     WIRE_BYTES.labels(direction=_d)
     WIRE_MESSAGES.labels(direction=_d)
 
+# ----------------------------------------------------- wire codec frames
+
+# Every codec the framing layer can put on the wire (wire.CODECS mirrors
+# this; the tuple lives here so the catalogue stays import-light) —
+# pre-seeded like the methods so /metrics always shows the full matrix.
+WIRE_CODECS = ("u8", "packed", "u8+zlib", "packed+zlib", "xrle")
+
+WIRE_FRAMES = REGISTRY.counter(
+    "gol_wire_frames_total",
+    "Codec-framed board payloads sent, by codec chosen after "
+    "negotiation (legacy raw-u8 sends to caps-less peers are counted "
+    "under gol_wire_messages_total only).",
+    label_names=("codec",))
+WIRE_FRAME_BYTES = REGISTRY.counter(
+    "gol_wire_frame_bytes_total",
+    "Encoded payload bytes of sent board frames, by codec.",
+    label_names=("codec",))
+WIRE_BYTES_SAVED = REGISTRY.counter(
+    "gol_wire_bytes_saved_total",
+    "Payload bytes NOT sent thanks to codec framing: sum over sent "
+    "frames of (raw u8 size h*w − encoded size).")
+WIRE_COMPRESSION_RATIO = REGISTRY.gauge(
+    "gol_wire_compression_ratio",
+    "raw u8 size / encoded size of the most recently sent board frame "
+    "(8.0 = pure packed, higher = compression on top).")
+WIRE_ENCODE_SECONDS = REGISTRY.histogram(
+    "gol_wire_encode_seconds",
+    "Seconds spent encoding a board frame before/while sending, by "
+    "codec (banded senders accrue encode time as chunks stream).",
+    label_names=("codec",))
+WIRE_DECODE_SECONDS = REGISTRY.histogram(
+    "gol_wire_decode_seconds",
+    "Seconds spent decoding a received board frame, by codec.",
+    label_names=("codec",))
+
+for _c in WIRE_CODECS:
+    WIRE_FRAMES.labels(codec=_c)
+    WIRE_FRAME_BYTES.labels(codec=_c)
+
 # ---------------------------------------------------------------- server
 
 SERVER_REQUESTS = REGISTRY.counter(

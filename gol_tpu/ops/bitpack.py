@@ -165,6 +165,51 @@ def gen4_transition(b0, b1, born, surv):
             (a & ~surv) | dying1)
 
 
+def pack_np(cells: np.ndarray) -> np.ndarray:
+    """Host-side mirror of `pack` for the wire data plane: uint8 (H, W)
+    board → LSB-first packed bytes (H, ceil(W/32)*4), byte-identical to
+    the little-endian word bytes of the device `pack` output. Any nonzero
+    input counts as alive (so both {0,1} cells and {0,255} pixels pack to
+    the same bits). Pure numpy — no device dispatch — and W need not be
+    word-aligned: trailing columns are zero-padded to the word boundary."""
+    if cells.ndim != 2:
+        raise ValueError("pack_np expects a 2-D board")
+    h, w = cells.shape
+    wp = -(-w // WORD_BITS)
+    if w != wp * WORD_BITS:
+        padded = np.zeros((h, wp * WORD_BITS), dtype=np.uint8)
+        padded[:, :w] = cells != 0
+        cells = padded
+    return np.packbits(np.ascontiguousarray(cells), axis=1,
+                       bitorder="little")
+
+
+def unpack_np(payload, h: int, w: int) -> np.ndarray:
+    """Inverse host-side decode: a buffer of h*ceil(w/32)*4 LSB-first
+    packed bytes → {0,1} uint8 (h, w). Accepts bytes/memoryview or a
+    uint8 ndarray; always returns a fresh writable array."""
+    wp = -(-w // WORD_BITS)
+    if isinstance(payload, np.ndarray):
+        raw = np.ascontiguousarray(payload, dtype=np.uint8).reshape(-1)
+    else:
+        raw = np.frombuffer(payload, dtype=np.uint8)
+    if raw.size != h * wp * 4:
+        raise ValueError(
+            f"packed payload is {raw.size} bytes, want {h * wp * 4} "
+            f"for a {h}x{w} board")
+    return np.unpackbits(raw.reshape(h, wp * 4), axis=1, count=w,
+                         bitorder="little")
+
+
+def words_bytes_np(words: np.ndarray) -> np.ndarray:
+    """(rows, Wp) uint32 host words → their wire bytes (rows, Wp*4),
+    little-endian regardless of host endianness — the zero-copy (on LE
+    hosts) bridge from a device_get of the packed representation to the
+    `packed` wire codec."""
+    a = np.ascontiguousarray(words.astype("<u4", copy=False))
+    return a.view(np.uint8)
+
+
 def packed_step(packed: jax.Array, rule: LifeLikeRule = CONWAY) -> jax.Array:
     """One whole-board torus turn on a (H, Wp) uint32 packed board."""
     above = jnp.roll(packed, 1, axis=-2)

@@ -30,6 +30,7 @@ from gol_tpu.obs import trace
 from gol_tpu.obs.metrics import REGISTRY
 from gol_tpu.params import Params
 from gol_tpu.utils.envcfg import env_float, env_int
+from gol_tpu import wire
 from gol_tpu.wire import recv_msg, send_msg
 
 DEFAULT_PORT = 8080  # reference broker port (`Server/gol/distributor.go:235`)
@@ -68,6 +69,15 @@ class EngineServer:
         max_conns = env_int(MAX_CONNS_ENV, MAX_CONNS_DEFAULT, minimum=0)
         self._conn_slots = (
             threading.BoundedSemaphore(max_conns) if max_conns else None)
+        # Per-viewer last-served live-view frames, keyed by the client's
+        # "vkey": the xrle codec deltas the next GetView reply against
+        # the frame that viewer already holds. Bounded LRU — a handful
+        # of concurrent viewers is the design point, and an eviction
+        # only costs one full-frame resend.
+        self._view_cache: dict = {}
+        self._view_cache_lock = threading.Lock()
+
+    VIEW_CACHE_MAX = 4
 
     def serve_forever(self) -> None:
         while not self._shutdown.is_set():
@@ -75,6 +85,7 @@ class EngineServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 break
+            wire.enable_nodelay(conn)
             if (self._conn_slots is not None
                     and not self._conn_slots.acquire(blocking=False)):
                 # At the cap: refuse with a diagnosable error rather than
@@ -153,9 +164,55 @@ class EngineServer:
                 obs.SERVER_REQUEST_SECONDS.labels(method=label).observe(
                     time.monotonic() - t0)
 
+    def _reply(self, conn: socket.socket, header: dict, world=None,
+               frame=None) -> None:
+        """Every reply advertises this server's wire caps, so ANY
+        successful RPC (the distributor's attach ping, a flag ack)
+        teaches the client which codecs the next board transfer may
+        use. Old clients ignore the extra key."""
+        header.setdefault("caps", sorted(wire.local_caps()))
+        send_msg(conn, header, world, frame=frame)
+
+    def _board_frame(self, out, caps):
+        """Codec-frame a host pixel board under the peer's negotiated
+        caps, consulting the engine for the binary-pixels contract
+        (saves the probe pass; Generations engines answer False and keep
+        their gray levels out of the packed codec)."""
+        return wire.encode_board(
+            out, caps, binary=getattr(self.engine, "binary_pixels", None))
+
+    def _encode_view(self, header: dict, caps, out, turn: int,
+                     fy: int, fx: int):
+        """Frame a GetView reply, delta-encoding (xrle) against the
+        frame this viewer already holds when the negotiation, the
+        engine's diffability contract, and the client's declared basis
+        all line up; then remember `out` as the viewer's new basis."""
+        vkey = header.get("vkey")
+        use_cache = (wire.CAP_XRLE in caps
+                     and getattr(self.engine, "frames_diffable", False)
+                     and isinstance(vkey, str) and 0 < len(vkey) <= 64)
+        basis = basis_turn = None
+        if use_cache:
+            want = header.get("basis_turn")
+            with self._view_cache_lock:
+                ent = self._view_cache.get(vkey)
+            if ent is not None and ent[0] == want and ent[1] == (fy, fx):
+                basis_turn, _, basis = ent
+        frame = wire.encode_view_frame(
+            out, caps, basis=basis, basis_turn=basis_turn,
+            binary=getattr(self.engine, "binary_pixels", None))
+        if use_cache:
+            with self._view_cache_lock:
+                self._view_cache.pop(vkey, None)
+                self._view_cache[vkey] = (turn, (fy, fx), out)
+                while len(self._view_cache) > self.VIEW_CACHE_MAX:
+                    self._view_cache.pop(next(iter(self._view_cache)))
+        return frame
+
     def _dispatch_inner(
         self, conn: socket.socket, method, label: str, header: dict, world
     ) -> None:
+        caps = wire.negotiate(header)
         try:
             if method == "ServerDistributor":
                 p = Params(**header["params"])
@@ -166,54 +223,68 @@ class EngineServer:
                     start_turn=int(header.get("start_turn", 0)),
                     token=header.get("token"),
                 )
-                send_msg(conn, {"ok": True, "turn": turn}, out)
+                self._reply(conn, {"ok": True, "turn": turn},
+                            frame=self._board_frame(out, caps))
             elif method == "AbortRun":
                 aborted = self.engine.abort_run(header.get("token"))
-                send_msg(conn, {"ok": True, "aborted": aborted})
+                self._reply(conn, {"ok": True, "aborted": aborted})
             elif method == "Ping":
-                send_msg(conn, {"ok": True, "turn": self.engine.ping()})
+                self._reply(conn, {"ok": True, "turn": self.engine.ping()})
             elif method == "Stats":
-                send_msg(conn, {"ok": True, "stats": self.engine.stats()})
+                self._reply(conn,
+                            {"ok": True, "stats": self.engine.stats()})
             elif method == "GetMetrics":
                 # Full registry snapshot (engine, wire, server families)
                 # — the wire-native face of the /metrics endpoint.
-                send_msg(conn, {"ok": True, "metrics": REGISTRY.snapshot()})
+                self._reply(conn,
+                            {"ok": True, "metrics": REGISTRY.snapshot()})
             elif method == "Alivecount":
                 alive, turn = self.engine.alive_count()
-                send_msg(conn, {"ok": True, "alive": alive, "turn": turn})
+                self._reply(conn,
+                            {"ok": True, "alive": alive, "turn": turn})
             elif method == "GetWorld":
-                out, turn = self.engine.get_world()
-                send_msg(conn, {"ok": True, "turn": turn}, out)
+                if hasattr(self.engine, "get_world_frame"):
+                    # The engines' frame path: packed device words go
+                    # straight to the socket, banded, with no device-
+                    # side unpack — the PR-5 snapshot data plane.
+                    frame, turn = self.engine.get_world_frame(caps)
+                else:
+                    out, turn = self.engine.get_world()
+                    frame = self._board_frame(out, caps)
+                self._reply(conn, {"ok": True, "turn": turn}, frame=frame)
             elif method == "GetView":
                 # O(max_cells) downsampled live-view frame of the board
                 # (dense) or live window (sparse) — the remote analog
                 # of the engines' get_view.
                 out, turn, (fy, fx) = self.engine.get_view(
                     int(header.get("max_cells", 0)))
-                send_msg(conn, {"ok": True, "turn": turn,
-                                "fy": fy, "fx": fx}, out)
+                self._reply(conn, {"ok": True, "turn": turn,
+                                   "fy": fy, "fx": fx},
+                            frame=self._encode_view(header, caps, out,
+                                                    turn, fy, fx))
             elif method == "GetWindow":
                 # Sparse engines only: live-window pixels + torus origin.
                 out, (ox, oy), turn = self.engine.get_window()
-                send_msg(conn, {"ok": True, "turn": turn,
-                                "ox": ox, "oy": oy}, out)
+                self._reply(conn, {"ok": True, "turn": turn,
+                                   "ox": ox, "oy": oy},
+                            frame=self._board_frame(out, caps))
             elif method == "CFput":
                 self.engine.cf_put(int(header["flag"]))
-                send_msg(conn, {"ok": True})
+                self._reply(conn, {"ok": True})
             elif method == "DrainFlags":
                 self.engine.drain_flags(
                     pause_only=bool(header.get("pause_only", False)))
-                send_msg(conn, {"ok": True})
+                self._reply(conn, {"ok": True})
             elif method == "Checkpoint":
                 # Controller-triggered durable snapshot into the
                 # server's CONFIGURED directory (GOL_CKPT) — the client
                 # never chooses write paths on this host.
                 path, turn = self.engine.checkpoint_now(trigger="remote")
-                send_msg(conn, {"ok": True, "turn": turn,
-                                "manifest": os.path.basename(path)})
+                self._reply(conn, {"ok": True, "turn": turn,
+                                   "manifest": os.path.basename(path)})
             elif method == "RestoreRun":
                 turn = self._restore_run(str(header.get("path", "")))
-                send_msg(conn, {"ok": True, "turn": turn})
+                self._reply(conn, {"ok": True, "turn": turn})
             elif method == "Profile":
                 # Arm an on-demand jax.profiler capture of the next N
                 # engine turns, into the server's CONFIGURED directory
@@ -229,33 +300,34 @@ class EngineServer:
                                                  source="wire")
                     except ProfileUnavailable as e:
                         raise RuntimeError(str(e)) from e
-                    send_msg(conn, {"ok": True, **armed})
+                    self._reply(conn, {"ok": True, **armed})
                 else:
-                    send_msg(conn, {"ok": True,
-                                    "status": PROFILER.status()})
+                    self._reply(conn, {"ok": True,
+                                       "status": PROFILER.status()})
             elif method == "KillProg":
                 self.engine.kill_prog()
-                send_msg(conn, {"ok": True})
+                self._reply(conn, {"ok": True})
                 # Reference broker/worker die on KillProg (os.Exit(0),
                 # `SubServer/distributor.go:42-45`): bring the server down.
                 self.shutdown()
                 if os.environ.get("GOL_SERVER_EXIT_ON_KILL", "1") == "1":
                     threading.Timer(0.2, _exit_after_flush).start()
             else:
-                send_msg(conn, {"ok": False,
-                                "error": f"unknown method {method!r}"})
+                self._reply(conn, {"ok": False,
+                                   "error": f"unknown method {method!r}"})
         except EngineKilled as e:
             obs.SERVER_ERRORS.labels(method=label).inc()
-            send_msg(conn, {"ok": False, "error": f"killed: {e}"})
+            self._reply(conn, {"ok": False, "error": f"killed: {e}"})
         except PermissionError as e:
             obs.SERVER_ERRORS.labels(method=label).inc()
-            send_msg(conn, {"ok": False, "error": f"denied: {e}"})
+            self._reply(conn, {"ok": False, "error": f"denied: {e}"})
         except EngineBusy as e:
             obs.SERVER_ERRORS.labels(method=label).inc()
-            send_msg(conn, {"ok": False, "error": f"busy: {e}"})
+            self._reply(conn, {"ok": False, "error": f"busy: {e}"})
         except Exception as e:  # surface engine errors to the client
             obs.SERVER_ERRORS.labels(method=label).inc()
-            send_msg(conn, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+            self._reply(conn,
+                        {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
     def _restore_run(self, req: str) -> int:
         """RestoreRun target resolution: the request names a checkpoint

@@ -284,6 +284,34 @@ class SparseEngine(ControlFlagProtocol):
             raise RuntimeError("no board loaded")
         return self._window_pixels(pub), (pub[1], pub[2]), pub[3]
 
+    # Sparse frames are WINDOW-anchored and the origin moves as the
+    # pattern grows — the wire layer must never delta-encode them (see
+    # the get_view docstring); snapshots are always strict {0,255}.
+    frames_diffable = False
+    binary_pixels = True
+
+    def get_world_frame(self, caps) -> Tuple[object, int]:
+        """(wire.Frame, turn) for the live-window snapshot: the window
+        is already a packed device array, so a packed-capable peer gets
+        its words straight off the device — no unpack dispatch, no ×255
+        pixel materialization — and everyone else gets the usual pixel
+        encode."""
+        from gol_tpu import wire
+
+        self._check_alive()
+        with self._state_lock:
+            pub = self._pub
+        if pub is None:
+            raise RuntimeError("no board loaded")
+        caps = frozenset(caps)
+        packed, _, _, turn, _ = pub
+        h, w = packed.shape[0], packed.shape[1] * WORD_BITS
+        if wire.CAP_PACKED in caps:
+            words = np.asarray(jax.device_get(packed))
+            return wire.packed_words_frame(h, w, iter([words]), caps), turn
+        return wire.encode_board(self._window_pixels(pub), caps,
+                                 binary=True), turn
+
     def get_view(
         self, max_cells: int
     ) -> Tuple[np.ndarray, int, Tuple[int, int]]:

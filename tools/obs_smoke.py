@@ -111,7 +111,16 @@ def main() -> int:
                        "# TYPE gol_compile_seconds histogram",
                        "# TYPE gol_compile_step_signatures_total counter",
                        "# TYPE gol_profile_captures_total counter",
-                       "# TYPE gol_profile_armed gauge"):
+                       "# TYPE gol_profile_armed gauge",
+                       # wire codec frame families
+                       "# TYPE gol_wire_frames_total counter",
+                       "# TYPE gol_wire_frame_bytes_total counter",
+                       "# TYPE gol_wire_bytes_saved_total counter",
+                       "# TYPE gol_wire_compression_ratio gauge",
+                       "# TYPE gol_wire_encode_seconds histogram",
+                       "# TYPE gol_wire_decode_seconds histogram",
+                       'gol_wire_frames_total{codec="packed"}',
+                       'gol_wire_frames_total{codec="xrle"}'):
             if needle not in body:
                 problems.append(f"/metrics missing {needle!r}")
         if 'gol_profile_captures_total{status="ok"} 1' not in body:

@@ -22,7 +22,7 @@ name) are higher-is-better; "seconds"/"s"-unit metrics are
 lower-is-better. Deltas inside the noise floor (default 5%) are
 reported but never gate. A regression beyond --max-regression
 (default 10%) on any GATED metric (those matching --gate-pattern,
-default "cell-updates|turns/sec|cups") fails the run.
+default "cell-updates|turns/sec|cups|snapshot MB/s") fails the run.
 
 Exit codes: 0 = no gated regression; 1 = gated regression;
 2 = usage / no comparable metric overlap.
@@ -45,7 +45,7 @@ Metrics = Dict[str, Tuple[float, Optional[str]]]
 
 DEFAULT_NOISE_FLOOR = 5.0
 DEFAULT_MAX_REGRESSION = 10.0
-DEFAULT_GATE_PATTERN = r"cell-updates|turns/sec|cups"
+DEFAULT_GATE_PATTERN = r"cell-updates|turns/sec|cups|snapshot MB/s"
 
 
 def _add(metrics: Metrics, metric, value, unit=None) -> None:

@@ -1,0 +1,145 @@
+"""wire-smoke: prove the negotiated wire data plane end to end on CPU.
+
+Boots a real EngineServer on a loopback TCP socket and drives it with
+RemoteEngine clients, validating the full codec stack:
+
+  * the capability handshake: a fresh client learns the server's caps
+    from its first reply and the upload/snapshot path goes packed;
+  * a packed snapshot decodes bit-identically to the uploaded board
+    AND to a raw-u8 fetch by a capability-less client (GOL_WIRE_CAPS=""
+    — the old-peer interop contract);
+  * host bitpack parity: ops/bitpack.pack_np bytes == the device pack's
+    little-endian word bytes, so client decode and device layout agree;
+  * zlib framing round-trips and shrinks a sparse board;
+  * the SDL live-view path: the second GetView poll of an unchanged
+    board ships an xrle delta frame of 0 payload bytes;
+  * the gol_wire_* metric families record frames, payload bytes, and
+    bytes saved.
+
+Runs IN-PROCESS (no subprocess) so counters are directly readable and
+the run stays inside the tier-1 time budget. Exit 0 = pass.
+
+    make wire-smoke     # JAX_PLATFORMS=cpu python tools/wire_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# Runnable as `python tools/wire_smoke.py` from a bare clone: put the
+# repo root (this file's parent's parent) ahead of tools/ on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("GOL_SERVER_EXIT_ON_KILL", "0")
+
+
+def main() -> int:
+    import numpy as np
+
+    from gol_tpu import wire
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import Engine
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.ops.bitpack import pack, pack_np, words_bytes_np
+    from gol_tpu.params import Params
+    from gol_tpu.server import EngineServer
+
+    problems = []
+    rng = np.random.default_rng(0)
+
+    # ---- host/device bitpack parity -----------------------------------
+    import jax.numpy as jnp
+
+    cells = (rng.random((37, 96)) < 0.4).astype(np.uint8)
+    host_bytes = pack_np(cells * 255).tobytes()
+    dev_bytes = words_bytes_np(
+        np.asarray(pack(jnp.asarray(cells)))).tobytes()
+    if host_bytes != dev_bytes:
+        problems.append("pack_np bytes != device pack word bytes")
+
+    # ---- negotiated server/client round-trip --------------------------
+    n = 96
+    world = (rng.random((n, n)) < 0.25).astype(np.uint8) * 255
+    p = Params(threads=1, image_width=n, image_height=n, turns=0)
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    try:
+        cli = RemoteEngine(f"127.0.0.1:{srv.port}")
+        cli.ping()
+        if cli.peer_caps != wire.SUPPORTED_CAPS:
+            problems.append(f"handshake learned {sorted(cli.peer_caps)}, "
+                            f"want {sorted(wire.SUPPORTED_CAPS)}")
+        sent0 = obs_cat.WIRE_BYTES.labels(direction="sent").value
+        out, _ = cli.server_distributor(p, world)
+        got, _ = cli.get_world()
+        packed_sent = (obs_cat.WIRE_BYTES.labels(direction="sent").value
+                       - sent0)
+        if not np.array_equal(out, world):
+            problems.append("upload round-trip not bit-identical")
+        if not np.array_equal(got, world):
+            problems.append("packed snapshot not bit-identical")
+        # upload + echo + snapshot, all framed: far below 3 raw boards
+        if packed_sent >= 3 * n * n:
+            problems.append(f"negotiated transfer moved {packed_sent} "
+                            f"bytes — codecs not engaged?")
+
+        os.environ["GOL_WIRE_CAPS"] = ""
+        try:
+            raw_cli = RemoteEngine(f"127.0.0.1:{srv.port}")
+            raw, _ = raw_cli.get_world()
+        finally:
+            del os.environ["GOL_WIRE_CAPS"]
+        if not np.array_equal(raw, world):
+            problems.append("raw-u8 fallback fetch not bit-identical")
+
+        # ---- zlib framing ---------------------------------------------
+        frame = wire.encode_board(world, frozenset({wire.CAP_ZLIB}))
+        if frame.codec != wire.CODEC_U8_ZLIB:
+            problems.append(f"sparse board framed as {frame.codec}, "
+                            "want u8+zlib")
+        elif frame.nbytes >= n * n:
+            problems.append("zlib frame did not shrink a sparse board")
+
+        # ---- live-view xrle path --------------------------------------
+        cli.get_view(n * n)
+        before = obs_cat.WIRE_FRAMES.labels(codec="xrle").value
+        v2, _, _ = cli.get_view(n * n)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and obs_cat.WIRE_FRAMES.labels(codec="xrle").value \
+                <= before:
+            time.sleep(0.01)
+        if obs_cat.WIRE_FRAMES.labels(codec="xrle").value != before + 1:
+            problems.append("second GetView poll did not ship xrle")
+        if not np.array_equal(v2, world):
+            problems.append("xrle view not bit-identical")
+    finally:
+        srv.shutdown()
+
+    # ---- metric families ----------------------------------------------
+    frames_total = sum(
+        child.value
+        for child in obs_cat.WIRE_FRAMES.children().values())
+    if frames_total < 3:
+        problems.append(f"gol_wire_frames_total = {frames_total}, "
+                        "expected the run to meter frames")
+    if obs_cat.WIRE_BYTES_SAVED.value <= 0:
+        problems.append("gol_wire_bytes_saved_total never incremented")
+
+    if problems:
+        for msg in problems:
+            print(f"wire-smoke: FAIL: {msg}", file=sys.stderr)
+        return 1
+    print(f"wire-smoke: OK — packed snapshot moved {int(packed_sent)} "
+          f"bytes for a {n * n}-byte board (upload+echo+snapshot), "
+          f"{int(frames_total)} codec frames metered, "
+          f"{int(obs_cat.WIRE_BYTES_SAVED.value)} bytes saved")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
