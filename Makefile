@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -25,6 +25,20 @@ ckpt-smoke:
 # old-peer fallback, host/device bitpack parity (tools/wire_smoke.py).
 wire-smoke:
 	JAX_PLATFORMS=cpu python tools/wire_smoke.py
+
+# Headless chunk-loop overhead check, CPU-only: run the bench.py
+# --overhead matrix (512²/1024² × no viewer / 1 viewer / viewer+ckpt),
+# then gate the measured chunk_overhead_us legs against the committed
+# BASELINE.json ceilings — this also runs the baseline-integrity audit,
+# so an unwaivered lowered anchor fails here too.
+perf-smoke:
+	mkdir -p out
+	JAX_PLATFORMS=cpu python bench.py --overhead --turns 2048 \
+		| tee out/perf_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/perf_smoke.jsonl
+
+# Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

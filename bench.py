@@ -744,7 +744,8 @@ def bench_engine(turns: int = ENGINE_TURNS, ckpt_dir: str = "",
     else:
         parity, how = None, "no gate below the ash-settling horizon"
     detail = {"turns": turns, "elapsed_s": round(elapsed, 4),
-              "alive": alive, "alive_parity": parity, "parity_check": how}
+              "alive": alive, "alive_parity": parity, "parity_check": how,
+              "chunk_overhead_us": eng.stats().get("chunk_overhead_us")}
     if ckpt_dir and ckpt_every > 0:
         # Surface what the async writer actually did during the timed
         # run — "dropped" counts snapshots superseded by a newer one
@@ -773,6 +774,123 @@ def bench_engine(turns: int = ENGINE_TURNS, ckpt_dir: str = "",
     return 0 if parity is not False else 1
 
 
+# Overhead-matrix leg sizing: GOL_MAX_CHUNK pinned small so the run
+# retires MANY chunks (the per-chunk fixed cost is the thing under
+# measurement, so sample it ~64+ times), and the turn count stays tiny
+# enough that the leg finishes in seconds even on a CPU host — this leg
+# is part of `make perf-smoke`, which must be runnable headlessly.
+OVERHEAD_TURNS = 16_384
+OVERHEAD_MAX_CHUNK = 256
+
+
+def bench_overhead(sizes=(512, 1024), turns: int = 0) -> int:
+    """Small-board per-chunk host-overhead matrix: {512², 1024²} ×
+    {no viewer, 1 viewer, viewer+ckpt}, each leg a full engine-stack run
+    with GOL_MAX_CHUNK pinned small so per-chunk fixed costs dominate
+    and get sampled ~64 times. The reported number is the engine's own
+    `chunk_overhead_us` (host wall per retired chunk OUTSIDE the
+    device-result wait — dispatch, publish, metrics, flag polling; see
+    engine.server_distributor). This is the metric whose silent growth
+    caused the r04→r05 512² full-stack regression; BASELINE carries
+    generous host-independent ceilings for the no-viewer legs so
+    `make perf-gate`/`perf-smoke` catches the next one.
+
+    Detail carries the no-viewer turn path's zero-work witnesses: the
+    wire-encode-call and banded-copy counter deltas across the run."""
+    import os
+    import tempfile
+    import threading
+
+    from gol_tpu.engine import Engine
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.params import Params
+
+    turns = turns or OVERHEAD_TURNS
+    rc = 0
+    knobs = ("GOL_MAX_CHUNK", "GOL_CHUNK_TARGET", "GOL_PIPELINE_DEPTH",
+             "GOL_PIPELINE_BUDGET", "GOL_MESH", "GOL_CKPT",
+             "GOL_CKPT_EVERY", "GOL_CKPT_EVERY_TURNS", "GOL_CKPT_KEEP",
+             "GOL_CKPT_KEEP_EVERY", "GOL_TRACE", "GOL_RULE")
+    saved = {v: os.environ.get(v) for v in knobs}
+    try:
+        for v in knobs:
+            os.environ.pop(v, None)
+        os.environ["GOL_MAX_CHUNK"] = str(OVERHEAD_MAX_CHUNK)
+        for n in sizes:
+            for mode in ("no viewer", "1 viewer", "viewer+ckpt"):
+                with tempfile.TemporaryDirectory() as ckpt_dir:
+                    if mode == "viewer+ckpt":
+                        os.environ["GOL_CKPT"] = ckpt_dir
+                        os.environ["GOL_CKPT_EVERY_TURNS"] = str(
+                            max(1, turns // 4))
+                    else:
+                        os.environ.pop("GOL_CKPT", None)
+                        os.environ.pop("GOL_CKPT_EVERY_TURNS", None)
+                    rng = np.random.default_rng(0)
+                    world = ((rng.random((n, n)) < 0.25)
+                             .astype(np.uint8)) * 255
+                    eng = Engine()
+                    p = Params(threads=8, image_width=n, image_height=n,
+                               turns=turns)
+                    # warm: compile the chunk ladder so the timed run's
+                    # overhead numbers are not compile stalls (the engine
+                    # excludes them anyway; this keeps elapsed honest)
+                    eng.server_distributor(p, world)
+                    stop = threading.Event()
+                    viewer = None
+                    if mode != "no viewer":
+                        def _poll():
+                            while not stop.is_set():
+                                try:
+                                    eng.get_view(4096)
+                                except Exception:
+                                    pass
+                                stop.wait(0.02)
+                        viewer = threading.Thread(target=_poll,
+                                                  daemon=True)
+                        viewer.start()
+                    enc0 = obs_cat.WIRE_ENCODE_CALLS.value
+                    band0 = obs_cat.ENGINE_BAND_COPIES.value
+                    chunks0 = obs_cat.ENGINE_CHUNKS_TOTAL.value
+                    t0 = time.perf_counter()
+                    try:
+                        eng.server_distributor(p, world)
+                    finally:
+                        stop.set()
+                        if viewer is not None:
+                            viewer.join(5)
+                    elapsed = time.perf_counter() - t0
+                    stats = eng.stats()
+                    overhead = stats.get("chunk_overhead_us")
+                    chunks = obs_cat.ENGINE_CHUNKS_TOTAL.value - chunks0
+                    if overhead is None or chunks <= 0:
+                        print(f"BENCH LEG FAILED (overhead {n} {mode}): "
+                              f"no chunks retired", file=sys.stderr)
+                        rc |= 1
+                        continue
+                    _emit(
+                        f"chunk_overhead_us ({n}x{n}, {mode})",
+                        overhead, "us", None,
+                        {"size": n, "mode": mode, "turns": turns,
+                         "max_chunk": OVERHEAD_MAX_CHUNK,
+                         "chunks": int(chunks),
+                         "elapsed_s": round(elapsed, 4),
+                         "turns_per_s": round(turns / elapsed, 1),
+                         "wire_encode_calls":
+                             int(obs_cat.WIRE_ENCODE_CALLS.value - enc0),
+                         "band_copies":
+                             int(obs_cat.ENGINE_BAND_COPIES.value
+                                 - band0)},
+                    )
+    finally:
+        for v, val in saved.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=None,
@@ -799,6 +917,11 @@ def main() -> int:
     ap.add_argument("--ckpt-every", type=int, default=0, metavar="TURNS",
                     help="with --engine --ckpt-dir: checkpoint cadence "
                          "in turns")
+    ap.add_argument("--overhead", action="store_true",
+                    help="run the per-chunk host-overhead matrix only "
+                         "({512,1024}² × {no viewer, 1 viewer, "
+                         "viewer+ckpt}, GOL_MAX_CHUNK pinned small; "
+                         "emits the gated chunk_overhead_us lines)")
     ap.add_argument("--gen", action="store_true",
                     help="run the Generations-family leg (Brian's Brain "
                          "bit-plane kernel; combine with --size/--turns)")
@@ -910,6 +1033,14 @@ def _dispatch(args, ap) -> int:
             ap.error("--ksweep needs --size (dense configs only)")
         return bench_ksweep(args.size)
 
+    if args.overhead:
+        if args.size is not None or args.pattern != "dense" or args.gen \
+                or args.engine:
+            ap.error("--overhead is its own config; combine only with "
+                     "--turns")
+        return bench_overhead(
+            turns=args.turns if args.turns is not None else 0)
+
     if args.engine:
         if args.size is not None or args.pattern != "dense" or args.gen:
             ap.error("--engine is its own config; combine only with "
@@ -970,6 +1101,7 @@ def _dispatch(args, ap) -> int:
         rc |= leg(bench_dense, n, default_turns(n), args.warmup_turns)
     rc |= leg(bench_sparse, SPARSE_TURNS)
     rc |= leg(bench_engine)
+    rc |= leg(bench_overhead)
     # Wire data-plane legs (the 131072² wire line runs under --wire on
     # hosts with the RAM for two full pixel boards).
     for n in (512, 8192):

@@ -183,6 +183,9 @@ def _banded_device_rows(cells, h: int, row_nbytes: int):
               for r0 in range(0, h, band_rows)]
 
     def _stage(x) -> None:
+        # Counted so a test can prove the no-viewer turn path never
+        # starts a banded copy (snapshot work is strictly demand-driven).
+        obs.ENGINE_BAND_COPIES.inc()
         try:
             x.copy_to_host_async()
         except (AttributeError, RuntimeError):
@@ -562,6 +565,10 @@ class Engine(ControlFlagProtocol):
         # Rolling throughput telemetry for the Stats RPC.
         self._last_chunk = 0
         self._turns_per_s = 0.0
+        # Mean host-side µs per retired chunk spent outside the device
+        # wait (dispatch, publish, flag poll, pipeline bookkeeping) —
+        # the small-board scaling cost; refreshed at each metrics flush.
+        self._chunk_overhead_us = 0.0
         # Converged chunk size per (board shape, repr, mesh, target):
         # later runs of the same configuration start there, skipping the
         # synchronous ramp's round trips.
@@ -834,6 +841,57 @@ class Engine(ControlFlagProtocol):
         # Flag-service seconds accrued since the last chunk record — the
         # record attributes control-plane stall to the chunk it delayed.
         flag_pending = 0.0
+        # Hot-loop metric batching (r6): the registry's counters and
+        # histograms take a lock per update, which at µs chunk walls is
+        # real per-chunk overhead multiplied by millions of chunks. The
+        # loop accumulates in plain locals and flushes on a coarse
+        # interval + at run end, so scrapes lag by at most
+        # METRICS_FLUSH_SECONDS while the hot path pays int adds and a
+        # list append. The published (alive, turn) pair still moves
+        # every pop — coherence of the poll path is not batched.
+        pend_chunks = 0
+        pend_turns = 0
+        pend_elapsed: list = []
+        pend_flags: list = []
+        last_cups = 0.0
+        last_rate = 0.0
+        last_done_turn = start_turn
+        # Host-overhead accounting: per-iteration wall time minus the
+        # device token wait and excluded stalls (compile, pause, sync
+        # checkpoint) — the chunk_overhead_us gate metric.
+        host_overhead = 0.0
+        overhead_iters = 0
+        wait_accum = 0.0
+        last_flush = time.monotonic()
+        METRICS_FLUSH_SECONDS = 0.5
+        # Per-chunk spans only when someone consumes them (span export
+        # or flight dump configured): an unconsumed span is two dict
+        # builds, two lock acquisitions, and a ring append per chunk.
+        hot_spans = obs_trace.hot_spans_enabled()
+
+        def _flush_metrics(now: float) -> None:
+            """Drain the batched hot-loop telemetry into the registry."""
+            nonlocal pend_chunks, pend_turns, last_flush
+            if pend_chunks:
+                obs.ENGINE_CHUNKS_TOTAL.inc(pend_chunks)
+                obs.ENGINE_TURNS_TOTAL.inc(pend_turns)
+                obs.ENGINE_CHUNK_SECONDS.observe_batch(pend_elapsed)
+                pend_elapsed.clear()
+                pend_chunks = pend_turns = 0
+            if pend_flags:
+                obs.ENGINE_FLAG_SERVICE_SECONDS.observe_batch(pend_flags)
+                pend_flags.clear()
+            obs.ENGINE_TURN.set(last_done_turn)
+            obs.ENGINE_CHUNK_SIZE.set(chunk)
+            if last_cups > 0:
+                obs.ENGINE_CUPS.set(last_cups)
+            if last_rate > 0:
+                obs.ENGINE_TURNS_PER_S.set(last_rate)
+            if overhead_iters:
+                self._chunk_overhead_us = (
+                    host_overhead / overhead_iters * 1e6)
+                obs.ENGINE_CHUNK_OVERHEAD_US.set(self._chunk_overhead_us)
+            last_flush = now
         # Per-run pipeline depth: clamp so depth + 1 board generations fit
         # the board byte budget (a 2 GB flagship board still pipelines at
         # 3; a board near device-memory capacity degrades to
@@ -892,6 +950,8 @@ class Engine(ControlFlagProtocol):
             synchronous measurements — the ramp and depth-1 mode —
             windowed-rate once the pipeline is open)."""
             nonlocal chunk, last_pop, ramping, flag_pending, last_devpoll
+            nonlocal pend_chunks, pend_turns, wait_accum
+            nonlocal last_cups, last_rate, last_done_turn
             (_done_cells, done_token, done_k, done_turn,
              done_issue, done_span) = inflight.popleft()
             t_wait = time.monotonic()
@@ -899,6 +959,7 @@ class Engine(ControlFlagProtocol):
                 jax.device_get(done_token), dtype=np.int64).sum())
             now = time.monotonic()
             token_wait = now - t_wait
+            wait_accum += token_wait
             elapsed = now - last_pop
             last_pop = now
             if ramping or depth == 1:
@@ -927,15 +988,15 @@ class Engine(ControlFlagProtocol):
                     self._turns_per_s = rate
                 self._publish_locked(done_alive, done_turn)
             cups = (done_k * board_cells / elapsed) if elapsed > 0 else 0.0
-            obs.ENGINE_TURN.set(done_turn)
-            obs.ENGINE_CHUNK_SIZE.set(chunk)
-            obs.ENGINE_CHUNKS_TOTAL.inc()
-            obs.ENGINE_TURNS_TOTAL.inc(done_k)
-            obs.ENGINE_CHUNK_SECONDS.observe(elapsed)
+            # Batched telemetry: locals here, registry at the next flush.
+            last_done_turn = done_turn
+            pend_chunks += 1
+            pend_turns += done_k
+            pend_elapsed.append(elapsed)
             if cups > 0:
-                obs.ENGINE_CUPS.set(cups)
+                last_cups = cups
             if rate > 0:
-                obs.ENGINE_TURNS_PER_S.set(rate)
+                last_rate = rate
             if reporter is not None:
                 reporter.emit(
                     "chunk", turn=done_turn, turns=done_k,
@@ -948,15 +1009,19 @@ class Engine(ControlFlagProtocol):
             # The chunk span opened at issue closes here: it covers
             # dispatch + device compute + token wait, i.e. the chunk's
             # life in the pipeline, not just the host-side blocking.
-            done_span.attrs.update(alive=done_alive,
-                                   token_wait_s=round(token_wait, 6))
-            obs_trace.finish(done_span)
+            # None when hot spans are gated off (no consumer configured).
+            if done_span is not None:
+                done_span.attrs.update(alive=done_alive,
+                                       token_wait_s=round(token_wait, 6))
+                obs_trace.finish(done_span)
             if now - last_devpoll >= 2.0:
                 # Throttled gol_dev_* refresh: memory_stats() is a cheap
                 # local counter read, but once per chunk at µs chunk
                 # walls would still be noise.
                 obs_devstats.poll_device_memory()
                 last_devpoll = now
+            if now - last_flush >= METRICS_FLUSH_SECONDS:
+                _flush_metrics(now)
 
         # The run span: parents every chunk/flag span below, and itself
         # parents under whatever is on this thread's context stack — the
@@ -971,6 +1036,16 @@ class Engine(ControlFlagProtocol):
             while self._turn < target and not quit_run:
                 if self._killed or self._abort.is_set():
                     break
+                # Per-iteration host-overhead accounting: everything this
+                # iteration does on the host EXCEPT blocking on the
+                # device token (wait_accum, credited inside _pop_oldest)
+                # and excluded stalls (compile, pause, sync checkpoint)
+                # is per-chunk overhead — the quantity the overhead
+                # bench legs gate.
+                t_iter = time.monotonic()
+                wait_accum = 0.0
+                stall_excl = 0.0
+                count_overhead = False
                 preq = obs_prof.PROFILER.take()
                 if preq is not None:
                     # On-demand capture (Profile RPC / POST /profile /
@@ -1046,14 +1121,19 @@ class Engine(ControlFlagProtocol):
                                       turn=self._turn + k, turns=k)
                     _reset_pace(time.monotonic())
                 else:
+                    count_overhead = True
                     t_issue = time.monotonic()
                     # Opened at issue, finished by _pop_oldest — the
                     # span rides the pipeline with its chunk (6th tuple
                     # element) so a flight dump mid-run shows exactly
-                    # which turns were in flight on the device.
-                    chunk_span = obs_trace.start(
+                    # which turns were in flight on the device. Gated:
+                    # with no span consumer configured the per-chunk
+                    # span is pure hot-loop overhead, so it is not
+                    # created at all (engine.run always is).
+                    chunk_span = (obs_trace.start(
                         "engine.chunk",
                         attrs={"k": k, "turn": self._turn + k})
+                        if hot_spans else None)
                     cells, token = tokened(cells, k)
                     issue_cost = time.monotonic() - t_issue
                     if issue_cost > 0.05:
@@ -1063,6 +1143,7 @@ class Engine(ControlFlagProtocol):
                         # chunk's own RTT+compute measurable while
                         # excluding the compile stall.
                         _reset_pace(last_pop + issue_cost)
+                        stall_excl += issue_cost
                     # Start the token's device->host copy NOW: the pop's
                     # device_get then reads a transfer that completed in
                     # the background instead of paying a serialized
@@ -1090,21 +1171,46 @@ class Engine(ControlFlagProtocol):
                     ) * ckpt_every_turns
                 if ckpt_path and \
                         time.monotonic() - last_ckpt >= ckpt_every:
+                    t_sync = time.monotonic()
                     self.save_checkpoint(ckpt_path)
                     last_ckpt = time.monotonic()
                     _reset_pace(last_ckpt)
+                    # A synchronous legacy save is a stall, not
+                    # per-chunk overhead (the manifest writer above is
+                    # async and DOES count).
+                    stall_excl += last_ckpt - t_sync
                 if self._turn < target:
                     # Only honour flags while turns remain — a pause landing
                     # with the final chunk must not park a finished run.
-                    t_flags = time.monotonic()
-                    with obs_trace.span("engine.flags"):
-                        quit_run = self._handle_flags()
-                    flag_cost = time.monotonic() - t_flags
-                    obs.ENGINE_FLAG_SERVICE_SECONDS.observe(flag_cost)
-                    flag_pending += flag_cost
-                    if flag_cost > 0.01:
-                        # A pause (or slow flag drain) stalled the host.
-                        _reset_pace(time.monotonic())
+                    # Fast path: reading the queue's underlying deque is
+                    # atomic, and an empty queue with no kill/abort set
+                    # means _handle_flags would do nothing — skip the
+                    # timer pair, the span, and the get_nowait/Empty
+                    # exception per chunk. A concurrent cf_put lands on
+                    # the next boundary, the same worst-case latency the
+                    # chunk wall already imposes.
+                    if (self._flags.queue or self._killed
+                            or self._abort.is_set()):
+                        t_flags = time.monotonic()
+                        if hot_spans:
+                            with obs_trace.span("engine.flags"):
+                                quit_run = self._handle_flags()
+                        else:
+                            quit_run = self._handle_flags()
+                        flag_cost = time.monotonic() - t_flags
+                        pend_flags.append(flag_cost)
+                        flag_pending += flag_cost
+                        if flag_cost > 0.01:
+                            # A pause (or slow flag drain) stalled the
+                            # host.
+                            _reset_pace(time.monotonic())
+                            stall_excl += flag_cost
+                if count_overhead:
+                    host_overhead += max(
+                        0.0,
+                        time.monotonic() - t_iter - wait_accum
+                        - stall_excl)
+                    overhead_iters += 1
             if ckpt_writer is not None and chunks_done > 0:
                 # Every loop exit inside the try — completion, quit,
                 # kill, abort — leaves durable state at the final turn,
@@ -1138,7 +1244,8 @@ class Engine(ControlFlagProtocol):
                 # Device error: return what we have. Close the orphaned
                 # chunk spans so they don't read as in-flight forever.
                 for _item in inflight:
-                    obs_trace.finish(_item[5])
+                    if _item[5] is not None:
+                        obs_trace.finish(_item[5])
                 inflight.clear()
             # The traced chunk (and a turns=0 run) bypass the token, so
             # the drained publication can trail the final turn by one
@@ -1172,6 +1279,10 @@ class Engine(ControlFlagProtocol):
                 # the engine forever (the daemon thread finishes or
                 # dies with the process).
                 ckpt_writer.close(timeout=60.0)
+            # Run-end flush: the batched counters/histograms land before
+            # anyone can observe the run as finished, so post-run totals
+            # are exact (test_obs counts on this).
+            _flush_metrics(time.monotonic())
             obs.ENGINE_TURN.set(final_turn)
             if reporter is not None:
                 # Final gol_dev_* poll so the run report carries the
@@ -1184,6 +1295,7 @@ class Engine(ControlFlagProtocol):
                     chunks=chunks_done - traced_chunks,
                     traced_chunks=traced_chunks,
                     wall_s=round(time.monotonic() - run_t0, 6),
+                    chunk_overhead_us=round(self._chunk_overhead_us, 2),
                     device_kind=devmem["device_kind"],
                     dev_live_bytes=devmem["live_bytes"],
                     dev_peak_bytes=devmem["peak_bytes"])
@@ -1357,6 +1469,9 @@ class Engine(ControlFlagProtocol):
                 "packed": self._packed,
                 "chunk": self._last_chunk,
                 "turns_per_s": round(self._turns_per_s, 1),
+                # Mean host µs per chunk outside the device wait — the
+                # small-board scaling cost (bench --overhead gates it).
+                "chunk_overhead_us": round(self._chunk_overhead_us, 2),
                 "rule": self._rule.rulestring,
                 "devices": len(self._devices),
             }

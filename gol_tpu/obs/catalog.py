@@ -68,6 +68,18 @@ ENGINE_FLAG_SERVICE_SECONDS = REGISTRY.histogram(
     "Seconds the chunk loop spent servicing control flags between "
     "chunk issues.")
 
+ENGINE_CHUNK_OVERHEAD_US = REGISTRY.gauge(
+    "gol_engine_chunk_overhead_us",
+    "Mean host-side microseconds per retired chunk spent OUTSIDE the "
+    "device-result wait (dispatch, publish, metrics, flag polling, "
+    "pipeline bookkeeping) over the run so far; refreshed at each "
+    "metrics flush and at run end.")
+ENGINE_BAND_COPIES = REGISTRY.counter(
+    "gol_engine_band_copies_total",
+    "Banded device-to-host row copies started by snapshot streaming "
+    "(engine._banded_device_rows); stays flat while no viewer or "
+    "snapshot consumer is attached.")
+
 # ------------------------------------------------------------ wire bytes
 
 WIRE_BYTES = REGISTRY.counter(
@@ -116,6 +128,12 @@ WIRE_DECODE_SECONDS = REGISTRY.histogram(
     "gol_wire_decode_seconds",
     "Seconds spent decoding a received board frame, by codec.",
     label_names=("codec",))
+WIRE_ENCODE_CALLS = REGISTRY.counter(
+    "gol_wire_encode_calls_total",
+    "Board/view frame encode invocations (any codec, eager or banded). "
+    "Proves the no-viewer turn path does zero wire-encode work: this "
+    "counter must not move while chunks retire without a snapshot "
+    "consumer.")
 
 for _c in WIRE_CODECS:
     WIRE_FRAMES.labels(codec=_c)

@@ -57,15 +57,21 @@ class Counter:
 
 
 class Gauge:
-    """Point-in-time value (set/inc/dec)."""
+    """Point-in-time value (set/inc/dec).
+
+    `set` and `value` are lock-free: a Python float attribute store/load
+    is atomic under the GIL, and a gauge set is a plain overwrite — no
+    read-modify-write to protect. Only `inc`/`dec` (RMW) take the lock.
+    This matters because the engine publishes a handful of gauges at
+    every chunk boundary; at µs chunk walls the per-set lock was
+    measurable hot-loop overhead."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._value = 0.0
 
     def set(self, value: float) -> None:
-        with self._lock:
-            self._value = float(value)
+        self._value = float(value)
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
@@ -76,8 +82,7 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        with self._lock:
-            return self._value
+        return self._value
 
 
 class Histogram:
@@ -105,6 +110,24 @@ class Histogram:
             self._count += 1
             self._sum += value
             self._window.append(value)
+
+    def observe_batch(self, values: Sequence[float]) -> None:
+        """Record many observations under ONE lock acquisition — the
+        engine's chunk loop accumulates per-chunk latencies locally and
+        flushes them here on a coarse interval, so the hot path pays a
+        list append instead of a lock per chunk."""
+        if not values:
+            return
+        with self._lock:
+            for value in values:
+                value = float(value)
+                i = 0
+                while i < len(self._bounds) and value > self._bounds[i]:
+                    i += 1
+                self._bucket_counts[i] += 1
+                self._count += 1
+                self._sum += value
+                self._window.append(value)
 
     @property
     def count(self) -> int:
@@ -192,6 +215,9 @@ class MetricFamily:
 
     def observe(self, value: float) -> None:
         self._solo().observe(value)
+
+    def observe_batch(self, values: Sequence[float]) -> None:
+        self._solo().observe_batch(values)
 
     @property
     def value(self):

@@ -101,6 +101,12 @@ class ProfileController:
 
     def take(self) -> Optional[ProfileRequest]:
         """Consume the pending request (engine run loop only)."""
+        # The engine loop calls this once per chunk iteration; the
+        # attribute read is atomic under the GIL, and request() only
+        # ever transitions None -> request under the lock, so a racing
+        # arm is picked up one iteration later at worst.
+        if self._pending is None:
+            return None
         with self._lock:
             req, self._pending = self._pending, None
         return req

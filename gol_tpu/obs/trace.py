@@ -323,6 +323,20 @@ set_process_name = TRACER.set_process_name
 export_chrome = TRACER.export_chrome
 
 
+def hot_spans_enabled() -> bool:
+    """Should per-chunk hot-path spans (engine.chunk / engine.flags) be
+    recorded?  True when someone will actually consume them: a span
+    export destination (GOL_TRACE_SPANS, what --trace-spans sets) or a
+    flight-recorder dump path (GOL_FLIGHT) is configured.  Boundary
+    spans (engine.run, serve.*, rpc.*) are always recorded — this only
+    gates the spans whose cost scales with chunk count, which at small
+    board sizes is pure per-chunk host overhead.  The engine reads it
+    once per run, so flipping the env mid-run takes effect at the next
+    submitted board."""
+    return bool(os.environ.get(TRACE_SPANS_ENV, "").strip()
+                or os.environ.get(obs_flight.FLIGHT_ENV, "").strip())
+
+
 def export_from_env() -> Optional[str]:
     """Export to `GOL_TRACE_SPANS` if set (what `--trace-spans` sets);
     never raises — this runs on shutdown paths."""

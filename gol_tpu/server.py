@@ -169,8 +169,9 @@ class EngineServer:
         """Every reply advertises this server's wire caps, so ANY
         successful RPC (the distributor's attach ping, a flag ack)
         teaches the client which codecs the next board transfer may
-        use. Old clients ignore the extra key."""
-        header.setdefault("caps", sorted(wire.local_caps()))
+        use. Old clients ignore the extra key. The advert is memoized
+        in the wire layer (PR 6) — no env read or sort per reply."""
+        header.setdefault("caps", wire.advertised_caps())
         send_msg(conn, header, world, frame=frame)
 
     def _board_frame(self, out, caps):
@@ -212,7 +213,12 @@ class EngineServer:
     def _dispatch_inner(
         self, conn: socket.socket, method, label: str, header: dict, world
     ) -> None:
-        caps = wire.negotiate(header)
+        # One encoder per connection (the protocol is one request per
+        # connection): negotiation + advert resolve here, once, via the
+        # wire-layer memos — every frame built below reuses `enc.caps`
+        # without re-reading the environment or the peer header.
+        enc = wire.ConnectionEncoder(header)
+        caps = enc.caps
         try:
             if method == "ServerDistributor":
                 p = Params(**header["params"])

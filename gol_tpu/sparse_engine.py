@@ -47,6 +47,7 @@ from gol_tpu.engine import (
 )
 from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.models.sparse import SparseTorus
+from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import flight as obs_flight
 from gol_tpu.ops.bitpack import WORD_BITS, unpack
 from gol_tpu.utils.envcfg import env_float, env_int
@@ -117,6 +118,7 @@ class SparseEngine(ControlFlagProtocol):
         self._abort = threading.Event()
         self._last_chunk = 0
         self._turns_per_s = 0.0
+        self._chunk_overhead_us = 0.0
 
     # ------------------------------------------------------------------ RPC
 
@@ -201,10 +203,18 @@ class SparseEngine(ControlFlagProtocol):
         def _ckpt_submit(trigger: str) -> None:
             ckpt_writer.submit(self._ckpt_snapshot(trigger))
 
+        # Host-overhead accounting, same definition as the dense engine:
+        # per-iteration wall time minus the device span (`elapsed`
+        # already brackets run + the syncing alive_count) minus excluded
+        # stalls (sync checkpoint saves, slow flag service).
+        host_overhead = 0.0
+        overhead_iters = 0
         try:
             while self._turn < target and not quit_run:
                 if self._killed or self._abort.is_set():
                     break
+                t_iter = time.monotonic()
+                stall_excl = 0.0
                 k = min(chunk, target - self._turn)
                 if next_ckpt_turn is not None:
                     k = min(k, next_ckpt_turn - self._turn)
@@ -233,10 +243,25 @@ class SparseEngine(ControlFlagProtocol):
                     ) * ckpt_every_turns
                 if ckpt_path and \
                         time.monotonic() - last_ckpt >= ckpt_every:
+                    t_sync = time.monotonic()
                     self.save_checkpoint(ckpt_path)
                     last_ckpt = time.monotonic()
+                    stall_excl += last_ckpt - t_sync
                 if self._turn < target:
-                    quit_run = self._handle_flags()
+                    # Fast path: no pending flag, nothing to service —
+                    # one deque truthiness check instead of a call into
+                    # the queue machinery per chunk.
+                    if (self._flags.queue or self._killed
+                            or self._abort.is_set()):
+                        t_flags = time.monotonic()
+                        quit_run = self._handle_flags()
+                        flag_cost = time.monotonic() - t_flags
+                        if flag_cost > 0.01:
+                            stall_excl += flag_cost
+                host_overhead += max(
+                    0.0,
+                    time.monotonic() - t_iter - elapsed - stall_excl)
+                overhead_iters += 1
             if ckpt_writer is not None and self._turn > start_turn:
                 _ckpt_submit("final")
         except Exception:
@@ -250,6 +275,10 @@ class SparseEngine(ControlFlagProtocol):
         finally:
             if ckpt_writer is not None:
                 ckpt_writer.close(timeout=60.0)
+            if overhead_iters:
+                self._chunk_overhead_us = (
+                    host_overhead / overhead_iters * 1e6)
+                obs.ENGINE_CHUNK_OVERHEAD_US.set(self._chunk_overhead_us)
             with self._state_lock:
                 final_pub = self._pub
                 final_turn = self._turn
@@ -364,6 +393,7 @@ class SparseEngine(ControlFlagProtocol):
                 "sparse": True,
                 "chunk": self._last_chunk,
                 "turns_per_s": round(self._turns_per_s, 1),
+                "chunk_overhead_us": round(self._chunk_overhead_us, 2),
                 "rule": self._rule.rulestring,
                 "devices": len(self._devices),
             }

@@ -129,6 +129,23 @@ DEFAULT_BAND_BYTES = 32 << 20
 _XRLE_GAP = 16
 
 
+# Pre-resolved metric children (PR 6): `.labels(...)` costs a label-set
+# validation, a tuple build, and a family-lock acquisition per call —
+# fine per RPC, not fine per frame on the streaming path. The label
+# spaces here are tiny and closed (2 directions, 5 codecs), so resolve
+# every child once at import and index a plain dict afterwards.
+_BYTES_SENT = obs.WIRE_BYTES.labels(direction="sent")
+_BYTES_RECV = obs.WIRE_BYTES.labels(direction="received")
+_MSGS_SENT = obs.WIRE_MESSAGES.labels(direction="sent")
+_MSGS_RECV = obs.WIRE_MESSAGES.labels(direction="received")
+_FRAMES = {c: obs.WIRE_FRAMES.labels(codec=c) for c in CODECS}
+_FRAME_BYTES = {c: obs.WIRE_FRAME_BYTES.labels(codec=c) for c in CODECS}
+_ENCODE_SECONDS = {c: obs.WIRE_ENCODE_SECONDS.labels(codec=c)
+                   for c in CODECS}
+_DECODE_SECONDS = {c: obs.WIRE_DECODE_SECONDS.labels(codec=c)
+                   for c in CODECS}
+
+
 def max_board_cells() -> int:
     return env_int("GOL_MAX_BOARD_CELLS", DEFAULT_MAX_BOARD_CELLS)
 
@@ -166,6 +183,29 @@ def local_caps() -> frozenset:
         t.strip() for t in raw.split(",") if t.strip()) & SUPPORTED_CAPS
 
 
+# Negotiation/advert memos (PR 6): the request path used to rebuild the
+# peer∩local frozenset and re-read + re-sort GOL_WIRE_CAPS on every
+# message. Both are pure functions of (peer caps tuple, env value), so
+# one dict lookup replaces the set algebra. Keyed on the raw env string:
+# flipping GOL_WIRE_CAPS at runtime still takes effect immediately.
+_NEGOTIATE_CACHE: dict = {}
+_ADVERT_CACHE: dict = {}
+
+
+def advertised_caps() -> list:
+    """Sorted caps list for reply/request headers — the `"caps"` advert.
+    Memoized per GOL_WIRE_CAPS value; returns a fresh list so callers
+    may embed it in mutable headers."""
+    raw = os.environ.get("GOL_WIRE_CAPS")
+    got = _ADVERT_CACHE.get(raw)
+    if got is None:
+        if len(_ADVERT_CACHE) > 64:
+            _ADVERT_CACHE.clear()
+        got = tuple(sorted(local_caps()))
+        _ADVERT_CACHE[raw] = got
+    return list(got)
+
+
 def negotiate(header: dict) -> frozenset:
     """Caps usable for the REPLY to this request: the peer's advertised
     list ∩ ours. A peer that advertises nothing (every pre-PR-5 client)
@@ -173,8 +213,41 @@ def negotiate(header: dict) -> frozenset:
     peer = header.get("caps")
     if not isinstance(peer, (list, tuple)):
         return frozenset()
-    return frozenset(
-        c for c in peer if isinstance(c, str)) & local_caps()
+    try:
+        key = (tuple(peer), os.environ.get("GOL_WIRE_CAPS"))
+        cached = _NEGOTIATE_CACHE.get(key)
+    except TypeError:
+        # Unhashable junk in a hostile caps list — negotiate uncached.
+        key = cached = None
+    if cached is None:
+        cached = frozenset(
+            c for c in peer if isinstance(c, str)) & local_caps()
+        if key is not None:
+            if len(_NEGOTIATE_CACHE) > 256:
+                _NEGOTIATE_CACHE.clear()
+            _NEGOTIATE_CACHE[key] = cached
+    return cached
+
+
+class ConnectionEncoder:
+    """Per-connection precomputed encode state (PR 6): the negotiated
+    caps for frames TO this peer and the caps advert for headers, both
+    resolved once at connection setup instead of per reply. The server
+    builds one per accepted connection; anything that later streams
+    frames on that socket (snapshot replies, live-view pushes) reuses
+    `caps` without touching the environment or the peer header again."""
+
+    __slots__ = ("caps", "advert")
+
+    def __init__(self, header: Optional[dict] = None) -> None:
+        self.caps = negotiate(header) if header is not None \
+            else frozenset()
+        self.advert = advertised_caps()
+
+    def stamp(self, header: dict) -> dict:
+        """Add this connection's caps advert to a reply header."""
+        header.setdefault("caps", self.advert)
+        return header
 
 
 def enable_nodelay(sock: socket.socket) -> None:
@@ -239,6 +312,11 @@ def _build_frame(codec: str, h: int, w: int, nbytes: int, raw_nbytes: int,
     level-1 deflate does not actually shrink the payload — so a zlib
     codec on the wire always means nbytes < base size, which the
     receiver enforces as a bound."""
+    # Every encode funnels through here (encode_board, the band framers,
+    # and encode_view_frame via its plain-codec base), so this counter is
+    # the "did ANY wire-encode work happen" witness the no-viewer
+    # turn-path test pins to zero.
+    obs.WIRE_ENCODE_CALLS.inc()
     frame = Frame(codec, h, w, nbytes, raw_nbytes, None, extra)
     if CAP_ZLIB in caps and codec in (CODEC_U8, CODEC_PACKED) \
             and nbytes <= zlib_max_bytes():
@@ -530,17 +608,16 @@ def send_msg(
                     f"promised {frame.nbytes}")
     finally:
         if sent:
-            obs.WIRE_BYTES.labels(direction="sent").inc(sent)
-    obs.WIRE_MESSAGES.labels(direction="sent").inc()
+            _BYTES_SENT.inc(sent)
+    _MSGS_SENT.inc()
     if frame is not None:
-        obs.WIRE_FRAMES.labels(codec=frame.codec).inc()
-        obs.WIRE_FRAME_BYTES.labels(codec=frame.codec).inc(frame.nbytes)
+        _FRAMES[frame.codec].inc()
+        _FRAME_BYTES[frame.codec].inc(frame.nbytes)
         if frame.raw_nbytes > frame.nbytes:
             obs.WIRE_BYTES_SAVED.inc(frame.raw_nbytes - frame.nbytes)
         if frame.nbytes:
             obs.WIRE_COMPRESSION_RATIO.set(frame.raw_nbytes / frame.nbytes)
-        obs.WIRE_ENCODE_SECONDS.labels(codec=frame.codec).observe(
-            frame.encode_s)
+        _ENCODE_SECONDS[frame.codec].observe(frame.encode_s)
     return sent
 
 
@@ -601,8 +678,7 @@ def _recv_frame(sock: socket.socket, codec: str, meta: dict, h: int,
                 or xrle_basis[0] != meta.get("basis_turn"):
             raise WireProtocolError("xrle frame without matching basis")
         world = xrle_decode(buf, h, w, xrle_basis[1])
-    obs.WIRE_DECODE_SECONDS.labels(codec=codec).observe(
-        time.perf_counter() - t0)
+    _DECODE_SECONDS[codec].observe(time.perf_counter() - t0)
     return world
 
 
@@ -651,6 +727,6 @@ def recv_msg(sock: socket.socket,
                                     xrle_basis)
     finally:
         if tally.n:
-            obs.WIRE_BYTES.labels(direction="received").inc(tally.n)
-    obs.WIRE_MESSAGES.labels(direction="received").inc()
+            _BYTES_RECV.inc(tally.n)
+    _MSGS_RECV.inc()
     return header, world

@@ -1,0 +1,204 @@
+"""Chunk-loop hot-path guards (PR 6): the no-viewer turn path must do
+ZERO wire-encode / banded-copy work, per-chunk host overhead must stay
+under a generous ceiling, and the baseline-integrity audit must reject
+a BASELINE.json refresh that lowers a gated metric without a waiver —
+the r04→r05 512² full-stack regression (4.99M → 1.08M turns/s) was
+normalized away by exactly such a refresh.
+
+All engine assertions are COUNTER-based deltas (the metric registry is
+process-global); the single timing assertion uses a ceiling ~200×
+above the measured CPU value so it cannot flake on a loaded host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from gol_tpu.engine import Engine
+from gol_tpu.obs import catalog as obs
+from gol_tpu.params import Params
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import perf_compare  # noqa: E402  (tools/ is not a package)
+
+
+def _run(eng: Engine, n: int = 64, turns: int = 2048) -> None:
+    rng = np.random.default_rng(0)
+    world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
+    p = Params(threads=1, image_width=n, image_height=n, turns=turns)
+    eng.server_distributor(p, world)
+
+
+# ------------------------------------------------- no-viewer turn path
+
+
+def test_no_viewer_run_does_zero_encode_or_band_work(monkeypatch):
+    """While chunks retire with no viewer or snapshot consumer
+    attached, the wire-encode-call and banded-copy counters must not
+    move — the witnesses `bench.py --overhead` reports, asserted here
+    so a future per-chunk encode hook fails tier-1, not just the
+    bench."""
+    monkeypatch.setenv("GOL_MAX_CHUNK", "64")
+    eng = Engine()
+    chunks0 = obs.ENGINE_CHUNKS_TOTAL.value
+    enc0 = obs.WIRE_ENCODE_CALLS.value
+    band0 = obs.ENGINE_BAND_COPIES.value
+    _run(eng)
+    assert obs.ENGINE_CHUNKS_TOTAL.value - chunks0 >= 8
+    assert obs.WIRE_ENCODE_CALLS.value == enc0
+    assert obs.ENGINE_BAND_COPIES.value == band0
+
+
+def test_chunk_overhead_measured_and_under_ceiling(monkeypatch):
+    """chunk_overhead_us (host wall per retired chunk OUTSIDE the
+    device-result wait) must be measured, positive, and far below the
+    BASELINE ceiling. 20 ms/chunk is ~200× the measured CPU value —
+    this catches the r05 class of regression (~1.5e6 µs/chunk), never
+    scheduler jitter."""
+    monkeypatch.setenv("GOL_MAX_CHUNK", "64")
+    eng = Engine()
+    _run(eng)
+    stats = eng.stats()
+    assert 0 < stats["chunk_overhead_us"] < 20_000
+    # stats() rounds to 2 decimals; the gauge keeps full precision.
+    assert obs.ENGINE_CHUNK_OVERHEAD_US.value == pytest.approx(
+        stats["chunk_overhead_us"], abs=0.011)
+
+
+def test_repeat_run_adds_no_step_signatures(monkeypatch):
+    """The donation/recompile clause, counter-based: a second identical
+    run on a warm engine must register no new step signature (no fresh
+    jit trace of the step program)."""
+    monkeypatch.setenv("GOL_MAX_CHUNK", "64")
+    eng = Engine()
+    _run(eng, turns=512)
+    sig0 = obs.COMPILE_STEP_SIGNATURES.value
+    _run(eng, turns=512)
+    assert obs.COMPILE_STEP_SIGNATURES.value == sig0
+
+
+# -------------------------------------------- baseline-integrity audit
+
+
+def _baseline(path, value, *, waiver=None, unit="turns/s",
+              metric="turns/sec (512x512, full engine stack)"):
+    entry = {"value": value, "unit": unit}
+    if waiver is not None:
+        entry["waiver"] = waiver
+    with open(path, "w") as f:
+        json.dump({"published": {metric: entry}}, f)
+
+
+def _candidate(path, value, *, unit="turns/s",
+               metric="turns/sec (512x512, full engine stack)"):
+    with open(path, "w") as f:
+        f.write(json.dumps({"metric": metric, "value": value,
+                            "unit": unit, "vs_baseline": None,
+                            "detail": {}}) + "\n")
+
+
+def test_audit_rejects_unwaivered_baseline_lowering(tmp_path, capsys):
+    prev = str(tmp_path / "prev.json")
+    cur = str(tmp_path / "BASELINE.json")
+    cand = str(tmp_path / "cand.jsonl")
+    _baseline(prev, 5_000_000.0)
+    _baseline(cur, 1_000_000.0)        # lowered, no waiver
+    _candidate(cand, 1_000_000.0)      # candidate itself passes
+    rc = perf_compare.main([cur, cand, "--baseline-prev", prev])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "baseline_lowered" in out
+    assert "no waiver" in out
+
+
+def test_audit_accepts_waivered_lowering_referencing_changes(tmp_path,
+                                                             capsys):
+    prev = str(tmp_path / "prev.json")
+    cur = str(tmp_path / "BASELINE.json")
+    cand = str(tmp_path / "cand.jsonl")
+    changes = tmp_path / "CHANGES.md"
+    changes.write_text("r99: accepted slower chunks for durability\n")
+    _baseline(prev, 5_000_000.0)
+    _baseline(cur, 1_000_000.0,
+              waiver="accepted slower chunks for durability")
+    _candidate(cand, 1_000_000.0)
+    rc = perf_compare.main([cur, cand, "--baseline-prev", prev,
+                            "--changes", str(changes)])
+    assert rc == 0
+    assert "waived" in capsys.readouterr().out
+
+
+def test_audit_rejects_waiver_not_in_changes(tmp_path, capsys):
+    prev = str(tmp_path / "prev.json")
+    cur = str(tmp_path / "BASELINE.json")
+    cand = str(tmp_path / "cand.jsonl")
+    changes = tmp_path / "CHANGES.md"
+    changes.write_text("r99: unrelated note\n")
+    _baseline(prev, 5_000_000.0)
+    _baseline(cur, 1_000_000.0, waiver="this text exists nowhere")
+    _candidate(cand, 1_000_000.0)
+    rc = perf_compare.main([cur, cand, "--baseline-prev", prev,
+                            "--changes", str(changes)])
+    assert rc == 1
+    assert "waiver not found in CHANGES.md" in capsys.readouterr().out
+
+
+def test_audit_allows_raised_and_new_entries(tmp_path, capsys):
+    """Raising an anchor or adding a new gated metric needs no waiver —
+    only lowering does."""
+    prev = str(tmp_path / "prev.json")
+    cur = str(tmp_path / "BASELINE.json")
+    cand = str(tmp_path / "cand.jsonl")
+    _baseline(prev, 1_000_000.0)
+    with open(cur, "w") as f:
+        json.dump({"published": {
+            "turns/sec (512x512, full engine stack)":
+                {"value": 5_000_000.0, "unit": "turns/s"},
+            "chunk_overhead_us (512x512, no viewer)":
+                {"value": 2000.0, "unit": "us"},
+        }}, f)
+    _candidate(cand, 5_000_000.0)
+    rc = perf_compare.main([cur, cand, "--baseline-prev", prev])
+    assert rc == 0
+
+
+def test_audit_skipped_for_non_baseline_anchor(tmp_path):
+    """Artifact-vs-artifact comparisons have no committed anchor; the
+    audit must not manufacture one."""
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _candidate(a, 1_000_000.0)
+    _candidate(b, 1_000_000.0)
+    assert perf_compare.main([a, b]) == 0
+
+
+def test_overhead_unit_is_lower_is_better():
+    """The us-unit / overhead-named gate direction: growth is a
+    regression. Without this, the gate would celebrate the exact
+    failure it exists to catch."""
+    assert not perf_compare._higher_is_better(
+        "chunk_overhead_us (512x512, no viewer)", "us")
+    assert not perf_compare._higher_is_better("p99 flag latency", "ms")
+    assert perf_compare._higher_is_better(
+        "turns/sec (512x512, full engine stack)", "turns/s")
+
+
+def test_gate_fails_on_overhead_growth(tmp_path, capsys):
+    """End-to-end: a candidate whose chunk_overhead_us EXCEEDS the
+    baseline ceiling fails the gate (lower-is-better + gated
+    pattern)."""
+    base = str(tmp_path / "BASELINE.json")
+    good = str(tmp_path / "good.jsonl")
+    bad = str(tmp_path / "bad.jsonl")
+    metric = "chunk_overhead_us (512x512, no viewer)"
+    _baseline(base, 2000.0, unit="us", metric=metric)
+    _candidate(good, 70.0, unit="us", metric=metric)
+    _candidate(bad, 1_500_000.0, unit="us", metric=metric)  # r05 class
+    assert perf_compare.main([base, good]) == 0
+    assert perf_compare.main([base, bad]) == 1

@@ -18,14 +18,28 @@ flags needed — these are every perf artifact this repo produces):
       {metric: value | {"value": ..., "unit": ...}}}
 
 Delta semantics: rate metrics (unit ending "/s", or "/sec" in the
-name) are higher-is-better; "seconds"/"s"-unit metrics are
-lower-is-better. Deltas inside the noise floor (default 5%) are
-reported but never gate. A regression beyond --max-regression
-(default 10%) on any GATED metric (those matching --gate-pattern,
-default "cell-updates|turns/sec|cups|snapshot MB/s") fails the run.
+name) are higher-is-better; "seconds"/"s"/"us"/"ms"-unit metrics and
+overhead/latency-named metrics are lower-is-better. Deltas inside the
+noise floor (default 5%) are reported but never gate. A regression
+beyond --max-regression (default 10%) on any GATED metric (those
+matching --gate-pattern, default
+"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us") fails
+the run.
 
-Exit codes: 0 = no gated regression; 1 = gated regression;
-2 = usage / no comparable metric overlap.
+Baseline-integrity audit (PR 6): when the baseline file is a
+BASELINE.json, the tool also diffs it against its previous git
+revision (or --baseline-prev FILE) and prints a `baseline_lowered`
+table of every gated metric the committed anchor got WORSE at. A
+lowered entry must carry an explicit `"waiver"` string that appears
+in CHANGES.md — the r05 refresh silently normalized a 4.6x 512²
+full-stack regression away, and this rule makes that impossible to
+repeat: an unwaivered lowering fails the gate. --no-baseline-audit
+skips the audit (artifact-vs-artifact comparisons of historical
+files).
+
+Exit codes: 0 = no gated regression; 1 = gated regression or
+unwaivered baseline lowering; 2 = usage / no comparable metric
+overlap.
 
 `make perf-gate` runs this against the committed BASELINE.json.
 """
@@ -45,7 +59,9 @@ Metrics = Dict[str, Tuple[float, Optional[str]]]
 
 DEFAULT_NOISE_FLOOR = 5.0
 DEFAULT_MAX_REGRESSION = 10.0
-DEFAULT_GATE_PATTERN = r"cell-updates|turns/sec|cups|snapshot MB/s"
+DEFAULT_GATE_PATTERN = (
+    r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us")
+DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
 def _add(metrics: Metrics, metric, value, unit=None) -> None:
@@ -137,9 +153,107 @@ def _higher_is_better(metric: str, unit: Optional[str]) -> bool:
         return True
     if "/sec" in metric or "/s " in metric or "cups" in metric.lower():
         return True
-    if unit in ("s", "seconds") or "seconds" in metric:
+    if unit in ("s", "seconds", "ms", "us", "µs") or "seconds" in metric:
+        return False
+    # Cost-flavoured names: chunk_overhead_us, p99 latency, … — without
+    # this, a time-denominated gated metric would default to higher-is-
+    # better and the gate would reward the regression it exists to catch.
+    low = metric.lower()
+    if "overhead" in low or "latency" in low:
         return False
     return True  # throughput-flavoured by default
+
+
+# ------------------------------------------------- baseline integrity
+
+def parse_baseline_doc(text: str):
+    """BASELINE.json text → ({metric: (value, unit)}, {metric: waiver}).
+    Returns (None, None) when the text is not a BASELINE document."""
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return None, None
+    if not isinstance(doc, dict) or "published" not in doc:
+        return None, None
+    metrics: Metrics = {}
+    waivers: Dict[str, str] = {}
+    for metric, val in (doc.get("published") or {}).items():
+        if isinstance(val, dict):
+            _add(metrics, metric, val.get("value"), val.get("unit"))
+            w = val.get("waiver")
+            if isinstance(w, str) and w.strip():
+                waivers[str(metric)] = w.strip()
+        else:
+            _add(metrics, metric, val)
+    return metrics, waivers
+
+
+def _git_prev_text(path: str) -> Optional[str]:
+    """The most recent committed revision of `path` whose content
+    differs from the working copy — the anchor the current baseline is
+    an UPDATE of. None when git/history is unavailable (fresh clone
+    without the file, shallow history, not a repo)."""
+    import subprocess
+
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    name = os.path.basename(path)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            cur = f.read()
+        revs = subprocess.run(
+            ["git", "log", "-n", "16", "--format=%H", "--", name],
+            cwd=d, capture_output=True, text=True, timeout=30)
+        for rev in revs.stdout.split():
+            # ":./name" resolves relative to cwd, not the repo root.
+            out = subprocess.run(
+                ["git", "show", f"{rev}:./{name}"],
+                cwd=d, capture_output=True, text=True, timeout=30)
+            if out.returncode == 0 and out.stdout \
+                    and out.stdout != cur:
+                return out.stdout
+    except Exception:
+        return None
+    return None
+
+
+def audit_baseline(cur_text: str, prev_text: str, gate_re,
+                   changes_text: Optional[str]) -> Optional[list]:
+    """Diff two BASELINE.json revisions: one row per GATED metric the
+    current revision is WORSE at than the previous. Each lowering must
+    be waived — an explicit `"waiver"` string on the entry that also
+    appears in CHANGES.md (when readable), so every normalized
+    regression leaves a reviewable paper trail. Returns None when
+    either text is not a BASELINE document."""
+    cur, waivers = parse_baseline_doc(cur_text)
+    prev, _ = parse_baseline_doc(prev_text)
+    if cur is None or prev is None:
+        return None
+    rows = []
+    for metric in sorted(prev):
+        if metric not in cur or not gate_re.search(metric):
+            continue
+        prev_v, prev_u = prev[metric]
+        cur_v, cur_u = cur[metric]
+        hib = _higher_is_better(metric, cur_u or prev_u)
+        if (cur_v >= prev_v) if hib else (cur_v <= prev_v):
+            continue  # unchanged or raised — no integrity question
+        waiver = waivers.get(metric)
+        if not waiver:
+            problem = "no waiver"
+        elif changes_text is not None and waiver not in changes_text:
+            problem = "waiver not found in CHANGES.md"
+        else:
+            problem = None
+        rows.append({
+            "metric": metric, "unit": cur_u or prev_u,
+            "previous": prev_v, "current": cur_v,
+            "delta_pct": round(
+                (cur_v - prev_v) / abs(prev_v) * 100.0, 2)
+            if prev_v else None,
+            "waiver": waiver, "ok": problem is None,
+            "problem": problem,
+        })
+    return rows
 
 
 def compare(baseline: Metrics, candidate: Metrics,
@@ -204,6 +318,19 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON object "
                          "instead of the table")
+    ap.add_argument("--baseline-prev", metavar="FILE", default="",
+                    help="previous BASELINE.json revision for the "
+                         "integrity audit (default: most recent git "
+                         "revision of the baseline file that differs "
+                         "from it)")
+    ap.add_argument("--no-baseline-audit", action="store_true",
+                    help="skip the baseline-lowered integrity audit "
+                         "(for comparing historical artifacts)")
+    ap.add_argument("--changes", metavar="FILE",
+                    default=DEFAULT_CHANGES_PATH,
+                    help="CHANGES.md to validate waiver references "
+                         "against (default %(default)s, resolved "
+                         "relative to the baseline file)")
     args = ap.parse_args(argv)
     if len(args.files) < 2:
         ap.error("need a baseline and at least one candidate file")
@@ -226,6 +353,60 @@ def main(argv=None) -> int:
     failed = False
     any_overlap = False
     report = {"baseline": args.files[0], "candidates": []}
+
+    # Baseline-integrity audit: only meaningful when the anchor itself
+    # is a BASELINE.json (artifact-vs-artifact comparisons have no
+    # committed anchor to audit).
+    audit_rows = None
+    if not args.no_baseline_audit:
+        try:
+            with open(args.files[0], "r", encoding="utf-8") as f:
+                cur_text = f.read()
+        except OSError:
+            cur_text = ""
+        cur_doc, _ = parse_baseline_doc(cur_text)
+        prev_text = None
+        if cur_doc is not None:
+            if args.baseline_prev:
+                try:
+                    with open(args.baseline_prev, "r",
+                              encoding="utf-8") as f:
+                        prev_text = f.read()
+                except OSError as e:
+                    print(f"perf_compare: cannot read --baseline-prev: "
+                          f"{e}", file=sys.stderr)
+                    return 2
+            else:
+                prev_text = _git_prev_text(args.files[0])
+        if prev_text is not None:
+            changes_path = args.changes
+            if not os.path.isabs(changes_path):
+                changes_path = os.path.join(
+                    os.path.dirname(os.path.abspath(args.files[0])),
+                    changes_path)
+            changes_text = None
+            try:
+                with open(changes_path, "r", encoding="utf-8") as f:
+                    changes_text = f.read()
+            except OSError:
+                pass  # no CHANGES.md to check references against
+            audit_rows = audit_baseline(cur_text, prev_text, gate_re,
+                                        changes_text)
+    if audit_rows:
+        report["baseline_lowered"] = audit_rows
+        if any(not r["ok"] for r in audit_rows):
+            failed = True
+        if not args.json:
+            print("== baseline_lowered (committed anchor vs its "
+                  "previous revision)")
+            width = max(len(r["metric"]) for r in audit_rows)
+            for r in audit_rows:
+                verdict = ("waived: " + r["waiver"] if r["ok"]
+                           else "FAIL: " + r["problem"])
+                print(f"  {r['metric']:<{width}}  "
+                      f"{r['previous']:>14.6g} -> "
+                      f"{r['current']:>14.6g}  "
+                      f"{(r['delta_pct'] or 0):>+8.2f}%  {verdict}")
     for path in args.files[1:]:
         try:
             candidate = load_metrics(path)
@@ -263,8 +444,12 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     elif failed:
-        print("perf-gate: FAIL (regression beyond "
-              f"{args.max_regression:g}% on a gated metric)")
+        if audit_rows and any(not r["ok"] for r in audit_rows):
+            print("perf-gate: FAIL (baseline lowered a gated metric "
+                  "without a CHANGES.md-referenced waiver)")
+        else:
+            print("perf-gate: FAIL (regression beyond "
+                  f"{args.max_regression:g}% on a gated metric)")
     else:
         print("perf-gate: ok")
     return 1 if failed else 0
