@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -37,8 +37,20 @@ perf-smoke:
 		| tee out/perf_smoke.jsonl
 	python tools/perf_compare.py BASELINE.json out/perf_smoke.jsonl
 
+# Fleet aggregate-throughput check, CPU-only: bench.py --fleet runs
+# 1/64/512 resident 512² boards in one FleetEngine against a
+# wire-driven single run, then gates the aggregate cups, the >=10x
+# speedup, and the fleet chunk_overhead_us ceiling against
+# BASELINE.json (same metric names as the full-window bench; the
+# short window only shrinks the measurement, not the topology).
+fleet-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --fleet \
+		--fleet-window 1.0 | tee out/fleet_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/fleet_smoke.jsonl
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

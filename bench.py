@@ -891,6 +891,223 @@ def bench_overhead(sizes=(512, 1024), turns: int = 0) -> int:
     return rc
 
 
+# Fleet leg sizing: run counts spanning single-run through saturated
+# batch, each measured over a free-running wall-clock window. The 512
+# count is the ISSUE's acceptance point (aggregate cups >= 10x a
+# wire-driven single run); 2048 is opt-in via --fleet-runs.
+FLEET_RUN_COUNTS = (1, 64, 512)
+FLEET_WINDOW_S = 3.0
+FLEET_SPEEDUP_FLOOR = 10.0
+
+
+def _fleet_expected(seed01: np.ndarray, turns: int) -> np.ndarray:
+    """{0,255} board after `turns` device torus turns of seed — the
+    fleet legs' parity oracle (same packed stencil, single board)."""
+    from gol_tpu.ops.bitpack import (
+        pack_np, packed_run_turns, unpack_np, words_bytes_np)
+
+    words = packed_run_turns(pack_np(seed01).view("<u4"), turns)
+    h, w = seed01.shape
+    out = unpack_np(words_bytes_np(np.asarray(words)), h, w)
+    return (out * np.uint8(255)).astype(np.uint8)
+
+
+def _bench_fleet_single_wire(n: int, window_s: float):
+    """Comparator leg: ONE n² run served the pre-fleet interactive way
+    — a loopback EngineServer + RemoteEngine driven turn-by-turn over
+    the wire (one ServerDistributor RPC per turn, board up + board
+    down each call). That is the full-stack cost of a run when every
+    run needs its own serving round trip; the fleet exists to amortize
+    exactly this. Returns (cups, detail) or raises."""
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import Engine
+    from gol_tpu.params import Params
+    from gol_tpu.server import EngineServer
+
+    rng = np.random.default_rng(0)
+    world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
+    srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+    srv.start_background()
+    try:
+        cli = RemoteEngine(f"127.0.0.1:{srv.port}")
+        p = Params(threads=1, image_width=n, image_height=n, turns=1)
+        board, turn = cli.server_distributor(p, world)  # warm/compile
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < window_s:
+            board, turn = cli.server_distributor(p, board,
+                                                 start_turn=turn)
+            reps += 1
+        elapsed = time.perf_counter() - t0
+        parity = bool(np.array_equal(board, _fleet_expected(
+            (world != 0).astype(np.uint8), turn)))
+    finally:
+        srv.shutdown()
+    if not parity:
+        raise RuntimeError("wire-driven single-run parity FAILED")
+    cups = reps * n * n / elapsed
+    return cups, {
+        "size": n, "turns": reps, "elapsed_s": round(elapsed, 4),
+        "turns_per_s": round(reps / elapsed, 1),
+        "ms_per_turn": round(elapsed / max(reps, 1) * 1e3, 3),
+        "alive_parity": parity,
+        "parity_check": "final board vs device torus replay, "
+                        "bit-identical",
+        "method": "1 ServerDistributor RPC per turn over loopback TCP "
+                  "(board up + board down each call) — the pre-fleet "
+                  "interactive serving path",
+    }
+
+
+def bench_fleet(run_counts=FLEET_RUN_COUNTS, n: int = 512,
+                window_s: float = FLEET_WINDOW_S) -> int:
+    """Fleet aggregate-throughput matrix (PR 7): N resident n² runs
+    free-running in one FleetEngine, measured over a wall-clock window
+    from the engine's retirement counters (fully synced — every
+    counted turn's popcount came back to the host). Reports aggregate
+    cell-updates/s per run count, p50/p99 per-run turn latency, the
+    fleet loop's chunk_overhead_us at the 64-run point (gated), the
+    zero-work witnesses (no viewers => zero wire encodes / band
+    copies during the window), and the acceptance ratio: aggregate
+    cups at the top run count vs ONE wire-driven single run
+    (>= 10x or the leg fails). Parity gate per leg: one sampled run's
+    board must be bit-identical to a device torus replay of its seed."""
+    import os
+
+    from gol_tpu.fleet import FleetEngine
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.obs import devstats
+
+    for var in ("GOL_CKPT", "GOL_CKPT_EVERY_TURNS", "GOL_RULE",
+                "GOL_FLEET_BUCKETS", "GOL_FLEET_CHUNK",
+                "GOL_FLEET_SLOT_BASE", "GOL_FLEET_MEM_BUDGET"):
+        os.environ.pop(var, None)
+    rc = 0
+    run_counts = tuple(sorted(run_counts))
+    top = run_counts[-1]
+
+    try:
+        single_cups, single_detail = _bench_fleet_single_wire(
+            n, min(window_s, 2.0))
+    except Exception as e:
+        print(f"BENCH LEG FAILED (fleet single-wire comparator): "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    _emit(f"cell-updates/sec ({n}x{n}, wire-driven single run)",
+          round(single_cups, 1), "cell-updates/s", None, single_detail)
+
+    rng = np.random.default_rng(1)
+    agg = {}
+    for count in run_counts:
+        eng = FleetEngine(bucket_sizes=(n,),
+                          slot_base=max(8, count))
+        try:
+            seed0 = None
+            for i in range(count):
+                seed = (rng.random((n, n)) < 0.25).astype(np.uint8)
+                if i == 0:
+                    seed0 = seed
+                eng.create_run(n, n, board=seed, run_id=f"b{i}",
+                               wait=False)
+            deadline = time.monotonic() + 120
+            while eng.runs_summary()["resident"] < count:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet placement timed out")
+                time.sleep(0.05)
+            # warm: the batched program compiles on the first quantum;
+            # measure only after turns are actually retiring.
+            warm0 = eng.throughput_counters()["board_turns"]
+            while eng.throughput_counters()["board_turns"] == warm0:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet loop never dispatched")
+                time.sleep(0.05)
+            sig0 = devstats.signature_count()
+            enc0 = obs_cat.WIRE_ENCODE_CALLS.value
+            band0 = obs_cat.ENGINE_BAND_COPIES.value
+            eng.reset_bench_window()
+            c0 = eng.throughput_counters()
+            t0 = time.perf_counter()
+            time.sleep(window_s)
+            c1 = eng.throughput_counters()
+            elapsed = time.perf_counter() - t0
+            p50, p99 = eng.latency_percentiles()
+            wire_calls = int(obs_cat.WIRE_ENCODE_CALLS.value - enc0)
+            band_copies = int(obs_cat.ENGINE_BAND_COPIES.value - band0)
+            new_sigs = devstats.signature_count() - sig0
+            # Parity: sampled run vs a device replay of its own seed.
+            rv = eng.resolve_run("b0")
+            board, turn = rv.get_world()
+            parity = bool(np.array_equal(
+                board, _fleet_expected(seed0, turn)))
+            overhead = c1["chunk_overhead_us"]
+        finally:
+            eng.kill_prog()
+        turns_ret = c1["board_turns"] - c0["board_turns"]
+        cells_ret = c1["cell_updates"] - c0["cell_updates"]
+        if turns_ret <= 0 or elapsed <= 0:
+            print(f"BENCH LEG FAILED (fleet {count}): nothing retired",
+                  file=sys.stderr)
+            rc |= 1
+            continue
+        if not parity:
+            print(f"PARITY FAIL (fleet {count} x {n}x{n}): sampled run "
+                  f"diverged from its torus replay", file=sys.stderr)
+            rc |= 1
+        if wire_calls or band_copies:
+            print(f"BENCH LEG FAILED (fleet {count}): zero-work "
+                  f"witnesses moved with no viewers attached "
+                  f"(wire_encode_calls={wire_calls}, "
+                  f"band_copies={band_copies})", file=sys.stderr)
+            rc |= 1
+        cups = cells_ret / elapsed
+        agg[count] = cups
+        detail = {
+            "runs": count, "size": n, "window_s": round(elapsed, 4),
+            "board_turns_retired": int(turns_ret),
+            "turns_per_run_per_s": round(
+                turns_ret / count / elapsed, 1),
+            "chunk_turns": eng.chunk_turns,
+            "p50_turn_latency_ms": round(p50 * 1e3, 3),
+            "p99_turn_latency_ms": round(p99 * 1e3, 3),
+            "chunk_overhead_us": overhead,
+            "new_step_signatures_in_window": int(new_sigs),
+            "wire_encode_calls": wire_calls,
+            "band_copies": band_copies,
+            "alive_parity": parity,
+            "parity_check": "sampled run's board vs device torus "
+                            "replay of its seed, bit-identical",
+            "method": "retirement-counter deltas over a free-running "
+                      "wall window; every counted turn fully synced",
+        }
+        _emit(f"aggregate cell-updates/sec (fleet, {count} x "
+              f"{n}x{n} runs)", round(cups, 1), "cell-updates/s",
+              None, detail)
+        if count == 64:
+            _emit(f"chunk_overhead_us (fleet, 64 x {n}x{n} runs, "
+                  f"no viewer)", overhead, "us", None,
+                  {"runs": count, "size": n,
+                   "wire_encode_calls": wire_calls,
+                   "band_copies": band_copies})
+    if top in agg and single_cups > 0:
+        speedup = agg[top] / single_cups
+        _emit(f"fleet aggregate cups speedup ({top} runs vs "
+              f"wire-driven single)", round(speedup, 2), "x", None,
+              {"runs": top, "size": n,
+               "aggregate_cups": round(agg[top], 1),
+               "single_wire_cups": round(single_cups, 1),
+               "floor": FLEET_SPEEDUP_FLOOR,
+               "comparator": "one run driven turn-by-turn over "
+                             "loopback TCP (the pre-fleet interactive "
+                             "serving path); both legs full-stack and "
+                             "fully synced"})
+        if speedup < FLEET_SPEEDUP_FLOOR:
+            print(f"BENCH LEG FAILED (fleet): aggregate speedup "
+                  f"{speedup:.1f}x < {FLEET_SPEEDUP_FLOOR:.0f}x "
+                  f"acceptance floor", file=sys.stderr)
+            rc |= 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=None,
@@ -932,6 +1149,21 @@ def main() -> int:
                     help="run the loopback snapshot data-plane leg(s) "
                          "only (server+client wire stack; --size for "
                          "one board, else 512/8192/131072)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet aggregate-throughput leg(s) "
+                         "only: N resident 512² runs in one "
+                         "FleetEngine vs a wire-driven single run "
+                         "(emits the gated aggregate cups, speedup, "
+                         "and fleet chunk_overhead_us lines)")
+    ap.add_argument("--fleet-runs", default="", metavar="N[,N...]",
+                    help="with --fleet: comma-separated resident run "
+                         "counts (default 1,64,512; the largest is "
+                         "the speedup acceptance point)")
+    ap.add_argument("--fleet-window", type=float, default=None,
+                    metavar="SEC",
+                    help="with --fleet: measurement window per run "
+                         "count (default 3.0; fleet-smoke uses a "
+                         "shorter one)")
     ap.add_argument("--ksweep", action="store_true",
                     help="two-point K-sweep for --size: marginal "
                          "per-turn cost + asymptotic cups + roofline")
@@ -1012,6 +1244,30 @@ def main() -> int:
 
 
 def _dispatch(args, ap) -> int:
+    if args.fleet:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead:
+            ap.error("--fleet is its own config; combine only with "
+                     "--size/--fleet-runs/--fleet-window")
+        if args.fleet_runs:
+            try:
+                counts = tuple(int(x) for x in
+                               args.fleet_runs.split(",") if x.strip())
+            except ValueError:
+                ap.error("--fleet-runs wants comma-separated integers")
+            if not counts or min(counts) < 1:
+                ap.error("--fleet-runs wants positive run counts")
+        else:
+            counts = FLEET_RUN_COUNTS
+        return bench_fleet(
+            run_counts=counts,
+            n=args.size if args.size is not None else 512,
+            window_s=(args.fleet_window if args.fleet_window
+                      else FLEET_WINDOW_S))
+    if args.fleet_runs or args.fleet_window is not None:
+        ap.error("--fleet-runs/--fleet-window apply to the --fleet "
+                 "leg only")
+
     if args.wire:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep:

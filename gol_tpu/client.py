@@ -66,10 +66,15 @@ class RemoteEngine:
     # disk during checkpointing — must propagate, not trigger reconnects).
     recoverable = True
 
-    def __init__(self, address: str, timeout: float = 10.0) -> None:
+    def __init__(self, address: str, timeout: float = 10.0,
+                 run_id: str = None) -> None:
         host, _, port = address.rpartition(":")
         self._addr = (host or "localhost", int(port))
         self._timeout = timeout
+        # Fleet run this client is bound to: stamped as the "run_id"
+        # header on every run-scoped call. None = the legacy single run
+        # (no header at all — pre-fleet servers never see the key).
+        self.run_id = run_id
         # Run-ownership token: lets abort_run() stop THIS controller's
         # orphaned run after a transient partition without being able to
         # touch a different controller's run.
@@ -98,6 +103,8 @@ class RemoteEngine:
               xrle_basis=None):
         label = obs.method_label(str(header.get("method")))
         header.setdefault("caps", sorted(wire.local_caps()))
+        if self.run_id is not None:
+            header.setdefault("run_id", self.run_id)
         obs.CLIENT_REQUESTS.labels(method=label).inc()
         t0 = time.monotonic()
         # The span sits on this thread's context stack while send_msg
@@ -147,6 +154,8 @@ class RemoteEngine:
             "token": self._token,
             "caps": sorted(wire.local_caps()),
         }
+        if self.run_id is not None:
+            header["run_id"] = self.run_id
         hb_interval = env_float(HB_INTERVAL_ENV, HB_INTERVAL_DEFAULT)
         hb_misses = env_int(HB_MISSES_ENV, HB_MISSES_DEFAULT)
 
@@ -342,6 +351,53 @@ class RemoteEngine:
         resp, _ = self._call({"method": "RestoreRun", "path": path},
                              timeout=max(self._timeout, 120.0))
         return int(resp["turn"])
+
+    # --- Fleet methods (PR 7) --------------------------------------------
+
+    def create_run(self, h: int, w: int, board: np.ndarray = None,
+                   run_id: str = None, rule: str = None,
+                   ckpt_every: int = 0, target_turn: int = None,
+                   queue: bool = False) -> dict:
+        """Admit a new run on a fleet server; returns its describe()
+        record ({"run_id", "state", "turn", ...}). An optional seed
+        board uploads on the request payload; without one the server
+        seeds a deterministic soup. Single-run servers answer with a
+        FleetUnsupported error suggesting --fleet."""
+        header = {"method": "CreateRun", "h": int(h), "w": int(w),
+                  "ckpt_every": int(ckpt_every),
+                  "queue": bool(queue)}
+        if run_id is not None:
+            header["run_id"] = run_id
+        if rule is not None:
+            header["rule"] = rule
+        if target_turn is not None:
+            header["target_turn"] = int(target_turn)
+        resp, _ = self._call(header, world=board, timeout=self._timeout)
+        return dict(resp["run"])
+
+    def list_runs(self) -> Tuple[list, dict]:
+        """([describe() records], fleet summary) — one run on
+        single-run servers, the whole fleet on --fleet ones."""
+        resp, _ = self._call({"method": "ListRuns"},
+                             timeout=self._timeout)
+        return list(resp["runs"]), dict(resp.get("summary", {}))
+
+    def attach_run(self, run_id: str) -> "RemoteEngine":
+        """Verify `run_id` exists on the server, then return a client
+        BOUND to it: every run-scoped call on the returned engine
+        carries the run_id header. Raises on unknown runs."""
+        resp, _ = self._call({"method": "AttachRun", "run_id": run_id},
+                             timeout=self._timeout)
+        bound = self.for_run(str(resp["run"]["run_id"]))
+        return bound
+
+    def for_run(self, run_id: str) -> "RemoteEngine":
+        """A bound clone addressing one fleet run (no server round
+        trip — use attach_run to also verify existence)."""
+        clone = RemoteEngine(f"{self._addr[0]}:{self._addr[1]}",
+                             timeout=self._timeout, run_id=run_id)
+        clone._peer_caps = self._peer_caps
+        return clone
 
     def cf_put(self, flag: int) -> None:
         self._call({"method": "CFput", "flag": int(flag)},

@@ -46,6 +46,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from gol_tpu.fleet.handles import SingleRunSurface
 from gol_tpu.models.generations import GenerationsRule
 from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.obs import catalog as obs
@@ -476,7 +477,7 @@ class ControlFlagProtocol:
                 return True
 
 
-class Engine(ControlFlagProtocol):
+class Engine(SingleRunSurface, ControlFlagProtocol):
     """Holds (world, turn) authoritatively across runs — the detach/resume
     contract (reference broker globals `world`/`turn`, `Server:29-30`, and
     the `CONT=yes` path, `Local/gol/distributor.go:171-178`)."""

@@ -1,0 +1,53 @@
+"""Fleet serving: batched multi-run engine (PR 7).
+
+Layout:
+
+  * `handles`   — RunHandle + the run-surface contract every engine
+                  implements (`SingleRunSurface` for the single-run
+                  engines); pure data, imports nothing heavy.
+  * `admission` — device-memory budgeting: admit / queue / reject.
+  * `buckets`   — padded size classes; one batched packed-stencil
+                  program per (shape, rule, quantum).
+  * `engine`    — FleetEngine (the scheduler loop) + RunView.
+
+`FleetEngine` is exported LAZILY: `gol_tpu.engine` imports
+`gol_tpu.fleet.handles` (for the SingleRunSurface mixin), which
+triggers this package __init__ — an eager `from .engine import
+FleetEngine` here would import `fleet.engine`, which imports
+`gol_tpu.engine` right back, mid-initialization. The module
+__getattr__ defers that edge until someone actually asks for the
+fleet engine, by which point `gol_tpu.engine` is fully loaded.
+"""
+
+from gol_tpu.fleet.admission import AdmissionController, run_cost
+from gol_tpu.fleet.handles import (
+    LEGACY_RUN_ID,
+    RUN_STATES,
+    FleetUnsupported,
+    RunHandle,
+    SingleRunSurface,
+    fits_bucket,
+    valid_run_id,
+)
+
+__all__ = [
+    "AdmissionController",
+    "run_cost",
+    "LEGACY_RUN_ID",
+    "RUN_STATES",
+    "FleetUnsupported",
+    "RunHandle",
+    "SingleRunSurface",
+    "fits_bucket",
+    "valid_run_id",
+    "FleetEngine",
+    "RunView",
+]
+
+
+def __getattr__(name: str):
+    if name in ("FleetEngine", "RunView"):
+        from gol_tpu.fleet import engine as _engine
+
+        return getattr(_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,218 @@
+"""Size buckets: how thousands of runs share a handful of programs.
+
+A fleet that compiled one program per run would drown in trace/compile
+churn (PR 4's `gol_compile_step_signatures_total` exists precisely to
+catch that). Instead runs are binned into a few PADDED SIZE CLASSES
+(default 512², 1024², 2048²): each bucket owns ONE batched device array
+of packed words, shape (cap, hb, wb/32) uint32, and one jitted program
+steps every slot of the batch in a single dispatch — the bit-plane
+stencil of `ops/bitpack.py` operates on the trailing two axes, so the
+leading slot axis batches for free.
+
+Placement is the PERIODIC-TILING trick (`handles.fits_bucket`): a board
+whose sides divide the bucket's is stamped as `np.tile` copies filling
+the slot. GoL commutes with translations and a periodic state stays
+periodic, so the bucket-torus evolution restricted to any board-sized
+window is bit-identical to the board's own torus evolution — padding
+costs capacity, never correctness (the parity test in test_fleet.py
+asserts exactly this). Per-run alive = slot popcount / tiles, exact.
+
+Boards that divide no configured class (e.g. the reference's 24×24 test
+board arriving from a legacy client) get a PRIVATE bucket shaped
+(h, lcm(w, 32)) — the legacy-parity guarantee outranks the shared-class
+economy, and such buckets cost one extra signature each. Fleet-created
+runs are instead rejected with reason "shape", keeping the compiled-
+program set bounded by the configured classes.
+
+Batch-shape stability: slot capacity is allocated in powers of two
+(`slot_base`, growing ×2), so admitting a run into existing free
+capacity reuses the compiled (cap, hb, wpb) program — zero new step
+signatures, the no-recompile-churn witness. Growth retraces once per
+doubling: O(log runs) signatures per bucket over the fleet's lifetime.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gol_tpu.fleet.handles import RunHandle, fits_bucket, tile_board
+from gol_tpu.obs import devstats
+from gol_tpu.ops.bitpack import (
+    WORD_BITS,
+    pack_np,
+    packed_step,
+    unpack_np,
+    words_bytes_np,
+)
+
+# Bucket side lengths (square, word-aligned). Overridable per engine
+# (GOL_FLEET_BUCKETS / constructor) — these are the paper-bench classes.
+DEFAULT_BUCKET_SIZES = (512, 1024, 2048)
+
+# Initial slot capacity per bucket; rounded up to a power of two.
+DEFAULT_SLOT_BASE = 8
+
+
+def choose_bucket_size(h: int, w: int,
+                       sizes: Sequence[int]) -> Optional[int]:
+    """Smallest configured bucket class the (h, w) board tiles exactly,
+    or None — fleet admission rejects with reason "shape"."""
+    for size in sorted(sizes):
+        if fits_bucket(h, w, size, size):
+            return size
+    return None
+
+
+def private_shape(h: int, w: int) -> Tuple[int, int]:
+    """The minimal word-aligned bucket an arbitrary board tiles: its
+    own height, width padded to lcm(w, 32) — the legacy-parity escape
+    hatch for boards outside every configured class."""
+    return h, math.lcm(w, WORD_BITS)
+
+
+@functools.lru_cache(maxsize=None)
+def step_program(rule, turns: int):
+    """The one compiled program per (rule, quantum): advance every slot
+    of a (cap, hb, wpb) packed batch `turns` turns and return
+    (words', per-slot popcount int32 (cap,)).
+
+    jit retraces per distinct batch shape — which is exactly the
+    signature economy the bucket layer engineers: shapes only change on
+    a pow2 capacity growth. The popcount rides the same dispatch, so
+    per-run alive telemetry costs no extra program launch."""
+
+    def prog(words):
+        def body(p, _):
+            return packed_step(p, rule), None
+
+        out, _ = lax.scan(body, words, None, length=turns)
+        # int32 is exact: a full 2048² slot popcounts to 4.2M << 2³¹.
+        alive = jnp.sum(lax.population_count(out), axis=(-1, -2),
+                        dtype=jnp.int32)
+        return out, alive
+
+    return jax.jit(prog)
+
+
+def board_to_words(board01: np.ndarray) -> np.ndarray:
+    """{0,1} (h, w) board -> host packed words (h, ceil(w/32)) '<u4'."""
+    return pack_np(board01).view("<u4")
+
+
+def words_to_board(words: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Inverse of board_to_words (host-side)."""
+    return unpack_np(words_bytes_np(np.asarray(words)), h, w)
+
+
+class Bucket:
+    """One padded size class: a batched device array plus its slot map.
+
+    Mutated only under the fleet engine's scheduling lock (placement,
+    stamping, dispatch all happen there), so no lock lives here."""
+
+    def __init__(self, hb: int, wb: int, rule,
+                 slot_base: int = DEFAULT_SLOT_BASE) -> None:
+        if wb % WORD_BITS:
+            raise ValueError(f"bucket width {wb} not word-aligned")
+        self.hb = int(hb)
+        self.wb = int(wb)
+        self.wpb = self.wb // WORD_BITS
+        self.rule = rule
+        cap = 1
+        while cap < max(1, slot_base):
+            cap *= 2
+        self.words = jnp.zeros((cap, self.hb, self.wpb), dtype=jnp.uint32)
+        self.slots: List[Optional[RunHandle]] = [None] * cap
+        self.free: List[int] = list(range(cap - 1, -1, -1))
+        # Round-robin bookkeeping the fairness test reads.
+        self.dispatches = 0
+        self.turns_served = 0
+
+    # ------------------------------------------------------------ slots
+
+    @property
+    def cap(self) -> int:
+        return len(self.slots)
+
+    @property
+    def occupied(self) -> int:
+        return self.cap - len(self.free)
+
+    def handles(self) -> List[RunHandle]:
+        return [h for h in self.slots if h is not None]
+
+    def active_count(self) -> int:
+        return sum(1 for h in self.slots if h is not None and h.active)
+
+    def _grow(self) -> None:
+        """Double capacity, preserving resident slots. One retrace per
+        doubling — the bounded, deliberate kind of signature churn."""
+        new_cap = self.cap * 2
+        grown = jnp.zeros((new_cap, self.hb, self.wpb), dtype=jnp.uint32)
+        self.words = grown.at[: self.cap].set(self.words)
+        self.free.extend(range(new_cap - 1, self.cap - 1, -1))
+        self.slots.extend([None] * self.cap)
+
+    def place(self, handle: RunHandle, board01: np.ndarray) -> int:
+        """Stamp a board into a free slot (tiled to fill it); returns
+        the slot index. Grows capacity ×2 when full."""
+        if not self.free:
+            self._grow()
+        slot = self.free.pop()
+        self.slots[slot] = handle
+        self.stamp(slot, board01)
+        return slot
+
+    def stamp(self, slot: int, board01: np.ndarray) -> None:
+        """(Re)write a slot's device words from a host {0,1} board —
+        placement, reseed, and pause-resume all land here."""
+        tiled = tile_board(np.asarray(board01, dtype=np.uint8),
+                           self.hb, self.wb)
+        host = np.ascontiguousarray(board_to_words(tiled))
+        self.words = self.words.at[slot].set(jnp.asarray(host))
+
+    def read_board(self, slot: int, h: int, w: int) -> np.ndarray:
+        """Host {0,1} board of a slot: device readback of the slot's
+        words, cropped to the run's own (h, w) window of the tiling."""
+        words = np.asarray(self.words[slot])  # (hb, wpb) — device sync
+        cells = words_to_board(words, self.hb, self.wb)
+        return np.ascontiguousarray(cells[:h, :w])
+
+    def slot_words(self, slot: int):
+        """The slot's packed device words (hb, wpb) — a cheap device
+        slice handle for async checkpoint submission."""
+        return self.words[slot]
+
+    def evict(self, slot: int, h: int, w: int) -> np.ndarray:
+        """Free a slot, returning its final cropped board. The words
+        stay in the device array (stepping garbage is harmless and
+        keeps the batch shape); the slot just becomes placeable."""
+        board = self.read_board(slot, h, w)
+        self.slots[slot] = None
+        self.free.append(slot)
+        return board
+
+    # --------------------------------------------------------- dispatch
+
+    def signature_key(self, turns: int) -> tuple:
+        return ("fleet", self.cap, self.hb, self.wpb, turns,
+                self.rule.rulestring)
+
+    def dispatch(self, turns: int):
+        """One serving quantum: advance every slot `turns` turns in a
+        single device dispatch. Returns the per-slot popcount DEVICE
+        array — the caller decides when to sync (that sync is the
+        fleet's device-wait measurement point)."""
+        devstats.note_signature(self.signature_key(turns))
+        prog = step_program(self.rule, turns)
+        self.words, alive = prog(self.words)
+        self.dispatches += 1
+        self.turns_served += turns
+        return alive

@@ -17,7 +17,8 @@ from gol_tpu.obs.metrics import REGISTRY
 WIRE_METHODS = (
     "ServerDistributor", "Alivecount", "GetWorld", "GetView", "GetWindow",
     "CFput", "DrainFlags", "KillProg", "Ping", "Stats", "AbortRun",
-    "GetMetrics", "Checkpoint", "RestoreRun", "Profile", "unknown",
+    "GetMetrics", "Checkpoint", "RestoreRun", "Profile",
+    "CreateRun", "ListRuns", "AttachRun", "unknown",
 )
 
 # ----------------------------------------------------------------- engine
@@ -176,6 +177,49 @@ for _m in WIRE_METHODS:
 def method_label(method: str) -> str:
     """Clamp arbitrary header method strings to the declared set."""
     return method if method in WIRE_METHODS else "unknown"
+
+
+# ------------------------------------------------------------ fleet runs
+
+RUNS_RESIDENT = REGISTRY.gauge(
+    "gol_runs_resident",
+    "Runs currently resident in the fleet engine (placed in a bucket "
+    "slot, charged against the admission memory budget). Single-run "
+    "engines leave this at 0.")
+RUNS_ADMITTED = REGISTRY.counter(
+    "gol_runs_admitted_total",
+    "CreateRun admissions (a run became resident), including queued "
+    "runs promoted when capacity freed.")
+RUNS_REJECTED = REGISTRY.counter(
+    "gol_runs_rejected_total",
+    "CreateRun rejections, by reason: memory (admission byte budget), "
+    "max_runs (GOL_FLEET_MAX_RUNS), queue_full, shape (board does not "
+    "tile any configured bucket), rule (unsupported rule family), "
+    "run_id (invalid or duplicate id).",
+    label_names=("reason",))
+
+# Same cardinality discipline as wire methods/flight reasons: reject
+# reasons are clamped to a declared set and pre-seeded at zero.
+RUN_REJECT_REASONS = ("memory", "max_runs", "queue_full", "shape",
+                      "rule", "run_id", "unknown")
+for _r in RUN_REJECT_REASONS:
+    RUNS_REJECTED.labels(reason=_r)
+
+
+def run_reject_label(reason: str) -> str:
+    """Clamp arbitrary rejection reasons to the declared set."""
+    return reason if reason in RUN_REJECT_REASONS else "unknown"
+
+
+def runs_doc() -> dict:
+    """The /healthz runs summary: resident gauge + admission counters
+    (registry reads only — never a device sync or an engine lock)."""
+    rejected = 0.0
+    for child in RUNS_REJECTED.children().values():
+        rejected += child.value
+    return {"resident": int(RUNS_RESIDENT.value),
+            "admitted_total": int(RUNS_ADMITTED.value),
+            "rejected_total": int(rejected)}
 
 
 # ------------------------------------------------- tracing / flight recorder

@@ -6,8 +6,9 @@ serves three read-only paths from in-process state:
   * `/metrics` (and `/`) — Prometheus text from the shared registry;
   * `/metrics.json` — the registry's dict snapshot, for tooling that
     would rather not parse exposition text;
-  * `/healthz` — 200 + `{"run_id", "turn", "uptime_s", "device_kind",
-    "live_bytes", "compile_count"}`, the liveness probe: run_id
+  * `/healthz` — 200 + `{"run_id", "turn", "uptime_s", "runs",
+    "device_kind", "live_bytes", "compile_count"}`, the liveness
+    probe ("runs" summarizes fleet residency/admissions): run_id
     identifies the process, turn proves the engine loop is advancing
     between polls, live_bytes/compile_count expose leak and
     recompile churn without a Prometheus scrape (both read the
@@ -46,7 +47,10 @@ def healthz_doc() -> dict:
     """The /healthz body (also used by tests without a socket)."""
     doc = {"run_id": obs_flight.RUN_ID,
            "turn": catalog.ENGINE_TURN.value,
-           "uptime_s": round(obs_flight.uptime_s(), 3)}
+           "uptime_s": round(obs_flight.uptime_s(), 3),
+           # Fleet summary (PR 7): resident/admitted/rejected run
+           # counts from the registry — zeros on single-run engines.
+           "runs": catalog.runs_doc()}
     doc.update(devstats.healthz_fields())
     return doc
 

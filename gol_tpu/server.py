@@ -174,24 +174,31 @@ class EngineServer:
         header.setdefault("caps", wire.advertised_caps())
         send_msg(conn, header, world, frame=frame)
 
-    def _board_frame(self, out, caps):
+    def _board_frame(self, out, caps, eng=None):
         """Codec-frame a host pixel board under the peer's negotiated
         caps, consulting the engine for the binary-pixels contract
         (saves the probe pass; Generations engines answer False and keep
         their gray levels out of the packed codec)."""
+        eng = eng if eng is not None else self.engine
         return wire.encode_board(
-            out, caps, binary=getattr(self.engine, "binary_pixels", None))
+            out, caps, binary=getattr(eng, "binary_pixels", None))
 
     def _encode_view(self, header: dict, caps, out, turn: int,
-                     fy: int, fx: int):
+                     fy: int, fx: int, eng=None):
         """Frame a GetView reply, delta-encoding (xrle) against the
         frame this viewer already holds when the negotiation, the
         engine's diffability contract, and the client's declared basis
         all line up; then remember `out` as the viewer's new basis."""
+        eng = eng if eng is not None else self.engine
         vkey = header.get("vkey")
         use_cache = (wire.CAP_XRLE in caps
-                     and getattr(self.engine, "frames_diffable", False)
+                     and getattr(eng, "frames_diffable", False)
                      and isinstance(vkey, str) and 0 < len(vkey) <= 64)
+        if use_cache and header.get("run_id"):
+            # Per-run basis namespace: the same viewer key watching two
+            # fleet runs must not delta one run's frame against the
+            # other's.
+            vkey = f"{header['run_id']}|{vkey}"
         basis = basis_turn = None
         if use_cache:
             want = header.get("basis_turn")
@@ -210,6 +217,28 @@ class EngineServer:
                     self._view_cache.pop(next(iter(self._view_cache)))
         return frame
 
+    # Methods that act on ONE run and therefore honour a `run_id`
+    # header: the engine's resolve_run maps it to a per-run surface
+    # (the engine itself for the legacy run / single-run engines, a
+    # fleet RunView otherwise). Engine-wide methods (KillProg,
+    # AbortRun, RestoreRun, Profile, GetMetrics, ListRuns, CreateRun)
+    # stay on the engine.
+    RUN_SCOPED = frozenset({
+        "ServerDistributor", "Ping", "Stats", "Alivecount", "GetWorld",
+        "GetView", "GetWindow", "CFput", "DrainFlags", "Checkpoint",
+    })
+
+    def _resolve_target(self, method, header: dict):
+        """The engine surface a request dispatches against. Peers that
+        never send run_id (every pre-fleet client) resolve to the
+        engine itself — the legacy single run — on ALL engine flavours;
+        unknown ids raise KeyError (mapped to an "unknown run" error)."""
+        rid = header.get("run_id")
+        if (method in self.RUN_SCOPED and rid not in (None, "")
+                and hasattr(self.engine, "resolve_run")):
+            return self.engine.resolve_run(str(rid))
+        return self.engine
+
     def _dispatch_inner(
         self, conn: socket.socket, method, label: str, header: dict, world
     ) -> None:
@@ -220,9 +249,10 @@ class EngineServer:
         enc = wire.ConnectionEncoder(header)
         caps = enc.caps
         try:
+            eng = self._resolve_target(method, header)
             if method == "ServerDistributor":
                 p = Params(**header["params"])
-                out, turn = self.engine.server_distributor(
+                out, turn = eng.server_distributor(
                     p,
                     world,
                     tuple(header.get("sub_workers", ())),
@@ -230,64 +260,94 @@ class EngineServer:
                     token=header.get("token"),
                 )
                 self._reply(conn, {"ok": True, "turn": turn},
-                            frame=self._board_frame(out, caps))
+                            frame=self._board_frame(out, caps, eng))
             elif method == "AbortRun":
                 aborted = self.engine.abort_run(header.get("token"))
                 self._reply(conn, {"ok": True, "aborted": aborted})
             elif method == "Ping":
-                self._reply(conn, {"ok": True, "turn": self.engine.ping()})
+                self._reply(conn, {"ok": True, "turn": eng.ping()})
             elif method == "Stats":
                 self._reply(conn,
-                            {"ok": True, "stats": self.engine.stats()})
+                            {"ok": True, "stats": eng.stats()})
             elif method == "GetMetrics":
                 # Full registry snapshot (engine, wire, server families)
                 # — the wire-native face of the /metrics endpoint.
                 self._reply(conn,
                             {"ok": True, "metrics": REGISTRY.snapshot()})
             elif method == "Alivecount":
-                alive, turn = self.engine.alive_count()
+                alive, turn = eng.alive_count()
                 self._reply(conn,
                             {"ok": True, "alive": alive, "turn": turn})
             elif method == "GetWorld":
-                if hasattr(self.engine, "get_world_frame"):
+                if hasattr(eng, "get_world_frame"):
                     # The engines' frame path: packed device words go
                     # straight to the socket, banded, with no device-
                     # side unpack — the PR-5 snapshot data plane.
-                    frame, turn = self.engine.get_world_frame(caps)
+                    frame, turn = eng.get_world_frame(caps)
                 else:
-                    out, turn = self.engine.get_world()
-                    frame = self._board_frame(out, caps)
+                    out, turn = eng.get_world()
+                    frame = self._board_frame(out, caps, eng)
                 self._reply(conn, {"ok": True, "turn": turn}, frame=frame)
             elif method == "GetView":
                 # O(max_cells) downsampled live-view frame of the board
                 # (dense) or live window (sparse) — the remote analog
                 # of the engines' get_view.
-                out, turn, (fy, fx) = self.engine.get_view(
+                vkey = header.get("vkey")
+                if (hasattr(eng, "subscribe_view")
+                        and isinstance(vkey, str) and 0 < len(vkey) <= 64):
+                    eng.subscribe_view(vkey)
+                out, turn, (fy, fx) = eng.get_view(
                     int(header.get("max_cells", 0)))
                 self._reply(conn, {"ok": True, "turn": turn,
                                    "fy": fy, "fx": fx},
                             frame=self._encode_view(header, caps, out,
-                                                    turn, fy, fx))
+                                                    turn, fy, fx, eng))
             elif method == "GetWindow":
                 # Sparse engines only: live-window pixels + torus origin.
-                out, (ox, oy), turn = self.engine.get_window()
+                out, (ox, oy), turn = eng.get_window()
                 self._reply(conn, {"ok": True, "turn": turn,
                                    "ox": ox, "oy": oy},
-                            frame=self._board_frame(out, caps))
+                            frame=self._board_frame(out, caps, eng))
             elif method == "CFput":
-                self.engine.cf_put(int(header["flag"]))
+                eng.cf_put(int(header["flag"]))
                 self._reply(conn, {"ok": True})
             elif method == "DrainFlags":
-                self.engine.drain_flags(
+                eng.drain_flags(
                     pause_only=bool(header.get("pause_only", False)))
                 self._reply(conn, {"ok": True})
             elif method == "Checkpoint":
                 # Controller-triggered durable snapshot into the
                 # server's CONFIGURED directory (GOL_CKPT) — the client
-                # never chooses write paths on this host.
-                path, turn = self.engine.checkpoint_now(trigger="remote")
+                # never chooses write paths on this host (fleet runs
+                # land in contained per-run subdirectories).
+                path, turn = eng.checkpoint_now(trigger="remote")
                 self._reply(conn, {"ok": True, "turn": turn,
                                    "manifest": os.path.basename(path)})
+            elif method == "CreateRun":
+                # Fleet admission: single-run engines answer with a
+                # FleetUnsupported error pointing at --fleet. The seed
+                # board (optional) rides the request payload exactly
+                # like a ServerDistributor world upload.
+                tt = header.get("target_turn")
+                rec = self.engine.create_run(
+                    int(header["h"]), int(header["w"]),
+                    board=world,
+                    run_id=header.get("run_id"),
+                    rule=header.get("rule"),
+                    ckpt_every=int(header.get("ckpt_every", 0)),
+                    target_turn=int(tt) if tt is not None else None,
+                    queue=bool(header.get("queue", False)))
+                self._reply(conn, {"ok": True, "run": rec})
+            elif method == "ListRuns":
+                self._reply(conn, {
+                    "ok": True,
+                    "runs": self.engine.list_runs(),
+                    "summary": self.engine.runs_summary()})
+            elif method == "AttachRun":
+                surf = self.engine.resolve_run(
+                    str(header.get("run_id") or ""))
+                self._reply(conn, {"ok": True,
+                                   "run": surf.describe_run()})
             elif method == "RestoreRun":
                 turn = self._restore_run(str(header.get("path", "")))
                 self._reply(conn, {"ok": True, "turn": turn})
@@ -321,6 +381,18 @@ class EngineServer:
             else:
                 self._reply(conn, {"ok": False,
                                    "error": f"unknown method {method!r}"})
+        except KeyError as e:
+            # resolve_run contract: unknown run ids raise KeyError with
+            # a presentable message ("unknown run 'x'"). Any other
+            # KeyError (a malformed request header) keeps the generic
+            # "ExcName: detail" shape clients already parse.
+            obs.SERVER_ERRORS.labels(method=label).inc()
+            msg = e.args[0] if e.args else ""
+            if isinstance(msg, str) and msg.startswith("unknown run"):
+                self._reply(conn, {"ok": False, "error": msg})
+            else:
+                self._reply(conn, {"ok": False,
+                                   "error": f"KeyError: {e}"})
         except EngineKilled as e:
             obs.SERVER_ERRORS.labels(method=label).inc()
             self._reply(conn, {"ok": False, "error": f"killed: {e}"})
@@ -428,7 +500,19 @@ def main() -> None:
                          "live window (life-like rules only; "
                          "GOL_SPARSE_SHARDS row-shards the window over "
                          "that many devices)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="serve the batched multi-run fleet engine: "
+                         "thousands of resident runs stepped in shared "
+                         "size-bucket dispatches, admitted against a "
+                         "device-memory budget (CreateRun/ListRuns/"
+                         "AttachRun + run_id routing on the existing "
+                         "methods; peers that never send run_id get the "
+                         "legacy single run, bit-identically; life-like "
+                         "rules only; GOL_FLEET_BUCKETS/GOL_FLEET_CHUNK/"
+                         "GOL_FLEET_MEM_BUDGET tune it)")
     args = ap.parse_args()
+    if args.fleet and args.sparse:
+        ap.error("--fleet and --sparse are mutually exclusive")
     if args.trace_spans:
         os.environ[trace.TRACE_SPANS_ENV] = args.trace_spans
     # Checkpoint knobs travel as env (the engine reads them at run
@@ -467,6 +551,10 @@ def main() -> None:
         from gol_tpu.sparse_engine import SparseEngine
 
         eng = SparseEngine(args.sparse, rule=rule)
+    elif args.fleet:
+        from gol_tpu.fleet import FleetEngine
+
+        eng = FleetEngine(rule=rule)
     else:
         eng = Engine(rule=rule)
     srv = EngineServer(port=args.port, host=args.host, engine=eng)

@@ -45,6 +45,7 @@ from gol_tpu.engine import (
     ControlFlagProtocol,
     EngineBusy,
 )
+from gol_tpu.fleet.handles import SingleRunSurface
 from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.models.sparse import SparseTorus
 from gol_tpu.obs import catalog as obs
@@ -56,7 +57,7 @@ SPARSE_CHUNK_MIN = 64
 SPARSE_CHUNK_MAX = 1 << 16
 
 
-class SparseEngine(ControlFlagProtocol):
+class SparseEngine(SingleRunSurface, ControlFlagProtocol):
     def __init__(self, size: int, rule=CONWAY,
                  shards: Optional[int] = None) -> None:
         """`shards` (r5): row-shard the live window over this many

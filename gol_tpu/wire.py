@@ -63,6 +63,19 @@ capture of the next N engine turns into the server's configured
 only ever picks the turn count — artifact paths are fixed server-side,
 same containment posture as Checkpoint/RestoreRun.
 
+Fleet runs (PR 7): `CreateRun` {"h", "w", "rule"?, "run_id"?,
+"turns"?, "ckpt_every"?, "queue"?} (+ optional seed board payload)
+admits a new resident run on a fleet server and replies {"run_id",
+"state", "bucket", "turn"}; `ListRuns` replies {"runs": [...],
+"summary": {...}}; `AttachRun` {"run_id"} replies that run's
+description. Every run-scoped method (`GetWorld`, `GetView`,
+`Alivecount`, `CFput`, `DrainFlags`, `Checkpoint`, `Stats`,
+`RestoreRun`) accepts an optional `"run_id"` header key routing it to
+one resident run; a missing run_id means the legacy default run, so
+capability-less pre-fleet peers behave bit-identically on a fleet
+server. Run ids are validated by `valid_run_id` BEFORE they reach any
+filesystem path (per-run checkpoint directories are keyed by run_id).
+
 Trace context: when the sending thread has an open span (obs/trace.py)
 and the header carries no explicit "tc", send_msg stamps the span's
 compact context — `"tc": {"t": <trace_id>, "s": <span_id>}` — into the
@@ -161,6 +174,23 @@ def band_bytes() -> int:
 def words(w: int) -> int:
     """Packed words per row for a board of width w."""
     return -(-w // WORD_BITS)
+
+
+# Run ids ride wire headers AND name per-run checkpoint directories
+# (ckpt base / "run-<id>"), so the alphabet is restricted to filename-
+# safe characters with no separators — a hostile run_id must not be
+# able to traverse paths or mint unbounded metric labels.
+RUN_ID_MAX = 64
+_RUN_ID_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+
+def valid_run_id(run_id) -> bool:
+    """True iff `run_id` is a protocol-legal run identifier."""
+    return (isinstance(run_id, str)
+            and 0 < len(run_id) <= RUN_ID_MAX
+            and not run_id.startswith((".", "-"))
+            and all(c in _RUN_ID_OK for c in run_id))
 
 
 class WireProtocolError(ConnectionError):

@@ -1,0 +1,357 @@
+"""Fleet engine (gol_tpu/fleet/): batched multi-run serving.
+
+Covers the subsystem's load-bearing claims: bucket tiling is EXACT
+(a run's board in a shared padded bucket evolves bit-identically to
+its own torus), admission is a device-memory budget with diagnosable
+rejects and a draining wait queue, the round-robin rotation cannot
+starve a bucket, admitting a run into existing capacity compiles
+nothing new (the PR-4 step-signature counter is the witness), run ids
+never traverse checkpoint paths, per-run checkpoints land in contained
+run-<id> directories that ckpt_inspect tabulates, /healthz carries the
+run summary, and a capability-less legacy peer on a --fleet server
+still gets its raw-u8 world bit-identical to the dense engine."""
+
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import wire
+from gol_tpu.client import RemoteEngine
+from gol_tpu.engine import FLAG_KILL, FLAG_PAUSE, Engine
+from gol_tpu.fleet import AdmissionController, FleetEngine, run_cost
+from gol_tpu.models import CONWAY
+from gol_tpu.obs import catalog as obs_cat
+from gol_tpu.obs import devstats
+from gol_tpu.ops.bitpack import (
+    pack_np,
+    packed_run_turns,
+    unpack_np,
+    words_bytes_np,
+)
+from gol_tpu.params import Params
+from gol_tpu.server import EngineServer
+
+
+def _soup(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
+def _replay(seed01, turns, rule=CONWAY):
+    """Single-board device torus replay — the parity oracle. Width must
+    be word-aligned so the packed torus IS the board's torus."""
+    h, w = seed01.shape
+    assert w % 32 == 0
+    words = packed_run_turns(pack_np(seed01).view("<u4"), turns, rule)
+    return unpack_np(words_bytes_np(np.asarray(words)), h, w)
+
+
+def _wait(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def fleet():
+    """Small, fast fleet: one 64² bucket, 2-turn quantum."""
+    eng = FleetEngine(bucket_sizes=(64,), chunk_turns=2, slot_base=2)
+    yield eng
+    eng.kill_prog()
+
+
+# ------------------------------------------------- bucket tiling parity
+
+
+@pytest.mark.parametrize("shape", [(64, 64), (32, 32), (32, 64)])
+def test_bucket_tiling_parity(fleet, shape):
+    """A board tiled into a shared 64² bucket slot must reach its
+    target bit-identical to stepping the board's OWN torus: GoL
+    commutes with translations, so a periodic tiling stays periodic
+    and any window evolves as the window's torus."""
+    h, w = shape
+    seed = _soup(h, w, seed=h * 100 + w)
+    rec = fleet.create_run(h, w, board=seed, run_id=f"p{h}x{w}",
+                           target_turn=12)
+    rv = fleet.resolve_run(rec["run_id"])
+    _wait(lambda: rv.stats()["turn"] == 12 and
+          rv.stats()["state"] == "parked",
+          what=f"run {rec['run_id']} to park at turn 12")
+    got, turn = rv.get_world()
+    assert turn == 12
+    expect = _replay(seed, 12)
+    np.testing.assert_array_equal((got != 0).astype(np.uint8), expect)
+    alive, alive_turn = rv.alive_count()
+    assert alive_turn == 12
+    assert alive == int(expect.sum())
+
+
+def test_target_not_multiple_of_quantum_is_exact(fleet):
+    """Targets are hit EXACTLY even when they don't divide the serving
+    quantum (the trim path runs the remainder on the single slot)."""
+    seed = _soup(64, 64, seed=9)
+    fleet.create_run(64, 64, board=seed, run_id="trim", target_turn=7)
+    rv = fleet.resolve_run("trim")
+    _wait(lambda: rv.stats()["state"] == "parked",
+          what="trim run to park")
+    got, turn = rv.get_world()
+    assert turn == 7
+    np.testing.assert_array_equal((got != 0).astype(np.uint8),
+                                  _replay(seed, 7))
+
+
+# ------------------------------------------------------------ admission
+
+
+def test_admission_rejects_and_queue_drains():
+    """Beyond the byte budget CreateRun rejects with a diagnosable
+    reason (metered), queue=True parks in the wait queue, and removing
+    a resident run promotes the queued one."""
+    cost = run_cost(64, 2)
+    eng = FleetEngine(bucket_sizes=(64,), chunk_turns=2, slot_base=2,
+                      admission=AdmissionController(budget_bytes=2 * cost))
+    try:
+        admitted0 = obs_cat.RUNS_ADMITTED.value
+        eng.create_run(64, 64, run_id="a")
+        eng.create_run(32, 32, run_id="b")  # small board, same slot cost
+        assert obs_cat.RUNS_ADMITTED.value == admitted0 + 2
+        rejected0 = sum(c.value for c in
+                        obs_cat.RUNS_REJECTED.children().values())
+        with pytest.raises(RuntimeError, match="memory"):
+            eng.create_run(64, 64, run_id="c")
+        assert sum(c.value for c in
+                   obs_cat.RUNS_REJECTED.children().values()) \
+            == rejected0 + 1
+        rec = eng.create_run(64, 64, run_id="d", queue=True)
+        assert rec["state"] == "queued"
+        eng.resolve_run("a").cf_put(FLAG_KILL)
+        _wait(lambda: eng.runs_summary()["resident"] == 2 and
+              eng.runs_summary()["queued"] == 0,
+              what="queued run to promote after a kill")
+        with pytest.raises(KeyError, match="unknown run"):
+            eng.resolve_run("a")
+        assert eng.resolve_run("d").stats()["state"] == "resident"
+    finally:
+        eng.kill_prog()
+
+
+def test_admission_rejects_misfit_shape_and_hostile_run_id(fleet):
+    with pytest.raises(RuntimeError, match="shape"):
+        fleet.create_run(48, 48)  # 48 divides no 64² bucket
+    for bad in ("../evil", "a/b", "run0", "x" * 65, ""):
+        with pytest.raises(RuntimeError, match="run_id"):
+            fleet.create_run(64, 64, run_id=bad)
+    with pytest.raises(RuntimeError, match="rule"):
+        fleet.create_run(64, 64, rule="/2/3")  # Generations: not life-like
+
+
+def test_run_id_never_reaches_checkpoint_paths(fleet, tmp_path):
+    """The directory mapper re-validates even internally-held ids: a
+    traversal-shaped id can never produce a filesystem path."""
+    with pytest.raises(PermissionError):
+        fleet._ckpt_dir("../escape", str(tmp_path))
+
+
+# ------------------------------------------------------ fair scheduling
+
+
+def test_round_robin_is_fair_across_buckets():
+    """Each non-empty bucket gets one quantum per rotation: a bucket
+    with 3 resident runs cannot starve the 1-run bucket (dispatch
+    counts stay balanced, not proportional to occupancy)."""
+    eng = FleetEngine(bucket_sizes=(32, 64), chunk_turns=2, slot_base=2)
+    try:
+        eng.create_run(32, 32, run_id="small")
+        for i in range(3):
+            eng.create_run(64, 64, run_id=f"big{i}")
+        _wait(lambda: eng.runs_summary()["resident"] == 4,
+              what="all runs resident")
+
+        def counts():
+            return {row["shape"]: row["dispatches"]
+                    for row in eng.stats()["fleet"]["buckets"]}
+
+        base = counts()
+        _wait(lambda: all(counts().get(k, 0) - v >= 8
+                          for k, v in base.items()),
+              what="both buckets to accumulate dispatches")
+        delta = {k: counts()[k] - base[k] for k in base}
+        small, big = delta["32x32"], delta["64x64"]
+        assert small > 0 and big > 0
+        # one-quantum-per-rotation: within 2x of each other, with
+        # slack for the rotation in flight when we sampled
+        assert abs(small - big) <= max(small, big) // 2 + 2
+    finally:
+        eng.kill_prog()
+
+
+# -------------------------------------------- batch-shape stability
+
+
+def test_adding_run_within_capacity_compiles_nothing(fleet):
+    """The tentpole's no-recompile-churn claim, witnessed by the PR-4
+    step-signature counter: admitting into existing slot capacity must
+    not introduce a single new program signature."""
+    fleet.create_run(64, 64, run_id="first")
+    rv = fleet.resolve_run("first")
+    _wait(lambda: rv.stats()["turn"] >= 2, what="first run stepping")
+    sig0 = devstats.signature_count()
+    fleet.create_run(64, 64, run_id="second")  # slot_base=2: capacity
+    rv2 = fleet.resolve_run("second")
+    t0 = rv2.stats()["turn"]
+    _wait(lambda: rv2.stats()["turn"] >= t0 + 4,
+          what="second run stepping")
+    assert devstats.signature_count() == sig0
+
+
+def test_pause_freezes_board_and_resume_continues(fleet):
+    seed = _soup(64, 64, seed=4)
+    fleet.create_run(64, 64, board=seed, run_id="pz")
+    rv = fleet.resolve_run("pz")
+    _wait(lambda: rv.stats()["turn"] >= 4, what="run stepping")
+    rv.cf_put(FLAG_PAUSE)
+    _wait(lambda: not rv.stats()["running"], what="pause to land")
+    board1, turn1 = rv.get_world()
+    time.sleep(0.2)
+    board2, turn2 = rv.get_world()
+    assert turn1 == turn2
+    np.testing.assert_array_equal(board1, board2)
+    np.testing.assert_array_equal((board1 != 0).astype(np.uint8),
+                                  _replay(seed, turn1))
+    rv.cf_put(FLAG_PAUSE)  # toggle: resume
+    _wait(lambda: rv.stats()["turn"] > turn1, what="resume to step")
+
+
+# --------------------------------------------------- per-run checkpoints
+
+
+def test_per_run_checkpoint_dirs_and_inspect(fleet, tmp_path):
+    """Fleet runs checkpoint into contained run-<id>/ subdirectories;
+    the legacy root layout is untouched and ckpt_inspect tabulates
+    both with a RUN column."""
+    from gol_tpu.ckpt import manifest as mf
+    from tools import ckpt_inspect
+
+    seed = _soup(64, 64, seed=11)
+    fleet.create_run(64, 64, board=seed, run_id="ck1", target_turn=4)
+    rv = fleet.resolve_run("ck1")
+    _wait(lambda: rv.stats()["state"] == "parked", what="ck1 to park")
+    path, turn = rv.checkpoint_now(directory=str(tmp_path))
+    assert turn == 4
+    rundir = tmp_path / "run-ck1"
+    assert rundir.is_dir() and path.startswith(str(rundir))
+    latest = mf.latest_checkpoint(str(rundir))
+    assert latest is not None and latest[0] == 4
+    # restored state is the checkpointed board exactly
+    m = mf.verify_manifest(latest[1])
+    assert m["board"] == {"h": 64, "w": 64}
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = ckpt_inspect.main(["list", str(tmp_path)])
+    assert rc == 0
+    rows = buf.getvalue().splitlines()
+    assert rows[0].split()[0] == "RUN"
+    assert any(line.split()[0] == "ck1" for line in rows[1:])
+
+
+# ----------------------------------------------------------- obs/healthz
+
+
+def test_healthz_runs_summary_tracks_admissions():
+    from gol_tpu.obs import catalog
+
+    doc0 = catalog.runs_doc()
+    assert set(doc0) == {"resident", "admitted_total", "rejected_total"}
+    eng = FleetEngine(bucket_sizes=(64,), chunk_turns=2, slot_base=2)
+    try:
+        eng.create_run(64, 64, run_id="hz")
+        with pytest.raises(RuntimeError):
+            eng.create_run(48, 48)
+        doc = catalog.runs_doc()
+        assert doc["admitted_total"] == doc0["admitted_total"] + 1
+        assert doc["rejected_total"] == doc0["rejected_total"] + 1
+    finally:
+        eng.kill_prog()
+
+
+# ------------------------------------------------- wire interop (legacy)
+
+
+@pytest.fixture
+def fleet_server(monkeypatch):
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    srv = EngineServer(port=0, host="127.0.0.1",
+                       engine=FleetEngine(bucket_sizes=(64,),
+                                          chunk_turns=2, slot_base=2))
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def test_legacy_no_caps_peer_bit_identical_on_fleet_server(
+        fleet_server, monkeypatch):
+    """Satellite (d): a pre-fleet, pre-codec client (no run_id, no
+    caps) on a --fleet server gets the same raw-u8 world the dense
+    engine would have produced — bit-identical, 24×24 (word-UNaligned,
+    so this exercises the private-bucket legacy path too)."""
+    monkeypatch.delenv("GOL_WIRE_CAPS", raising=False)
+    world = _soup(24, 24, seed=3) * np.uint8(255)
+    p = Params(threads=1, image_width=24, image_height=24, turns=6)
+
+    ref_eng = Engine()
+    expect, expect_turn = ref_eng.server_distributor(p, world)
+
+    monkeypatch.setenv("GOL_WIRE_CAPS", "")  # client sends no caps
+    boot = RemoteEngine(f"127.0.0.1:{fleet_server.port}")
+    got, turn = boot.server_distributor(p, world)
+    assert turn == expect_turn == 6
+    np.testing.assert_array_equal(got, expect)
+
+    # hand-rolled capability-less peer: raw-u8 decode, nothing but h*w
+    s = socket.create_connection(("127.0.0.1", fleet_server.port),
+                                 timeout=10)
+    try:
+        hdr = json.dumps({"method": "GetWorld"}).encode()
+        s.sendall(struct.pack(">I", len(hdr)) + hdr)
+        resp, raw = wire.recv_msg(s)
+        assert resp["ok"] is True
+        assert resp["world"].get("codec", "u8") == "u8"
+        np.testing.assert_array_equal(raw, expect)
+    finally:
+        s.close()
+
+
+def test_wire_create_list_attach_and_run_scoped_fetch(fleet_server):
+    """CreateRun/ListRuns/AttachRun round-trip, run_id-routed GetWorld,
+    and the unknown-run error shape."""
+    cli = RemoteEngine(f"127.0.0.1:{fleet_server.port}")
+    seed = _soup(64, 64, seed=21)
+    rec = cli.create_run(64, 64, board=seed * np.uint8(255),
+                         run_id="w1", target_turn=10)
+    assert rec["run_id"] == "w1"
+    runs, summary = cli.list_runs()
+    assert summary["engine"] == "FleetEngine"
+    assert any(r["run_id"] == "w1" for r in runs)
+
+    rv = cli.attach_run("w1")
+    _wait(lambda: rv.stats()["state"] == "parked", what="w1 to park")
+    got, turn = rv.get_world()
+    assert turn == 10
+    np.testing.assert_array_equal((got != 0).astype(np.uint8),
+                                  _replay(seed, 10))
+    # stats routed by run_id, not the legacy surface
+    assert rv.stats()["run_id"] == "w1"
+
+    with pytest.raises(RuntimeError, match="unknown run"):
+        cli.attach_run("nope")

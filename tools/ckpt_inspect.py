@@ -4,9 +4,11 @@
     python tools/ckpt_inspect.py verify DIR|MANIFEST
     python tools/ckpt_inspect.py diff   A B
 
-`list` tabulates every durable checkpoint (turn, trigger, repr, rule,
-board, alive, payload bytes, file) — malformed manifests are skipped
-unless --strict. `verify` re-parses every manifest AND recomputes the
+`list` tabulates every durable checkpoint (run, turn, trigger, repr,
+rule, board, alive, payload bytes, file) — malformed manifests are
+skipped unless --strict. Fleet directories (PR 7) nest per-run
+checkpoints in `run-<id>/` subdirectories; `list` walks those too,
+labelling each row with its run id ("-" = the legacy root run). `verify` re-parses every manifest AND recomputes the
 payload SHA-256 from disk (the same refusal gate `--resume` runs);
 exit 1 if anything fails. `diff` compares two checkpoints (manifest
 paths, or directories meaning their newest durable checkpoint) and
@@ -38,19 +40,39 @@ def _fmt_bytes(n: int) -> str:
     return f"{n}B"
 
 
+def _fleet_run_dirs(base: str) -> list:
+    """Fleet layout (PR 7): per-run checkpoints live in contained
+    `run-<id>` subdirectories under the configured root (the legacy
+    run keeps writing at the root itself). Returns sorted
+    (run_id, dir) pairs — empty for pre-fleet layouts."""
+    out = []
+    try:
+        names = sorted(os.listdir(base))
+    except OSError:
+        return out
+    for nm in names:
+        full = os.path.join(base, nm)
+        if nm.startswith("run-") and os.path.isdir(full):
+            out.append((nm[len("run-"):], full))
+    return out
+
+
 def cmd_list(args) -> int:
-    rows = [("TURN", "TRIGGER", "REPR", "RULE", "BOARD", "ALIVE",
+    rows = [("RUN", "TURN", "TRIGGER", "REPR", "RULE", "BOARD", "ALIVE",
              "BYTES", "FILE")]
     n = 0
-    for turn, path, m in mf.list_checkpoints(args.dir,
-                                             strict=args.strict):
-        board = m.get("board") or {}
-        rows.append((
-            str(turn), str(m.get("trigger", "?")), m["repr"], m["rule"],
-            f"{board.get('h', '?')}x{board.get('w', '?')}",
-            str(m.get("alive", "?")), _fmt_bytes(m["payload_bytes"]),
-            os.path.basename(path)))
-        n += 1
+    scan = [("-", args.dir)] + _fleet_run_dirs(args.dir)
+    for run_label, directory in scan:
+        for turn, path, m in mf.list_checkpoints(directory,
+                                                 strict=args.strict):
+            board = m.get("board") or {}
+            rows.append((
+                run_label, str(turn), str(m.get("trigger", "?")),
+                m["repr"], m["rule"],
+                f"{board.get('h', '?')}x{board.get('w', '?')}",
+                str(m.get("alive", "?")), _fmt_bytes(m["payload_bytes"]),
+                os.path.basename(path)))
+            n += 1
     if n == 0:
         print(f"{args.dir}: no durable checkpoints")
         return 1
