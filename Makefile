@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -49,8 +49,19 @@ fleet-smoke:
 		--fleet-window 1.0 | tee out/fleet_smoke.jsonl
 	python tools/perf_compare.py BASELINE.json out/fleet_smoke.jsonl
 
+# Serving-SLO load check, CPU-only: bench.py --load drives concurrent
+# create/attach/view/flag/destroy clients against an in-process fleet
+# server (tools/load_smoke.py) and gates the client-observed
+# per-method rpc p50/p99 ms lines against the committed BASELINE.json
+# ceilings (lower is better).
+load-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --load \
+		| tee out/load_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/load_smoke.jsonl
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -1108,6 +1108,91 @@ def bench_fleet(run_counts=FLEET_RUN_COUNTS, n: int = 512,
     return rc
 
 
+LOAD_CLIENTS = 4
+LOAD_CYCLES = 8
+LOAD_BOARD = 64
+
+
+def bench_load(clients: int = LOAD_CLIENTS,
+               cycles: int = LOAD_CYCLES, n: int = LOAD_BOARD) -> int:
+    """Serving-tier SLO leg (PR 8): N concurrent clients loop the
+    CreateRun -> AttachRun -> GetView -> CFput -> DestroyRun cycle
+    against an in-process fleet server (tools/load_smoke.py), and the
+    client-observed per-method p50/p99 land as GATED lower-is-better
+    BENCH lines ("rpc p50/p99 ms (load, <Method>)"). One single-client
+    warm cycle runs first so the measured window is serving cost, not
+    the bucket program's compile. Each line's detail carries the
+    server-side handler/wait split from the SLO estimators — the
+    decomposition that says WHERE a regression lives (accept queue vs
+    handler) before anyone reaches for a profiler."""
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import load_smoke
+
+    from gol_tpu.fleet import FleetEngine
+    from gol_tpu.obs import slo as obs_slo
+    from gol_tpu.server import EngineServer
+
+    for var in ("GOL_CKPT", "GOL_CKPT_EVERY_TURNS", "GOL_RULE",
+                "GOL_FLEET_BUCKETS", "GOL_FLEET_CHUNK",
+                "GOL_FLEET_SLOT_BASE", "GOL_FLEET_MEM_BUDGET",
+                "GOL_SLO_P99_MS"):
+        os.environ.pop(var, None)
+    obs_slo.reset()
+    eng = FleetEngine(bucket_sizes=(n,), chunk_turns=2,
+                      slot_base=max(8, clients * 2))
+    srv = EngineServer(port=0, host="127.0.0.1", engine=eng)
+    srv.start_background()
+    address = f"127.0.0.1:{srv.port}"
+    try:
+        warm = load_smoke.run_load(address, clients=1, cycles=1,
+                                   board=n)
+        if warm["errors"]:
+            print(f"BENCH LEG FAILED (load warmup): {warm['errors']}",
+                  file=sys.stderr)
+            return 1
+        obs_slo.reset()  # measure only the loaded window
+        result = load_smoke.run_load(address, clients=clients,
+                                     cycles=cycles, board=n)
+    finally:
+        eng.kill_prog()
+        srv.shutdown()
+    if result["errors"]:
+        print(f"BENCH LEG FAILED (load): {result['errors']}",
+              file=sys.stderr)
+        return 1
+    obs_slo.flush()
+    server_split = obs_slo.rpc_snapshot()
+    table = load_smoke.summarize(result["samples"])
+    rc = 0
+    for method in load_smoke.CYCLE_METHODS:
+        row = table.get(method)
+        if row is None:
+            print(f"BENCH LEG FAILED (load): no {method} samples",
+                  file=sys.stderr)
+            rc |= 1
+            continue
+        detail = {
+            "clients": clients, "cycles": cycles, "board": n,
+            "count": row["count"], "max_ms": row["max_ms"],
+            "wall_s": result["wall_s"],
+            "server_handler": (server_split.get("handler") or {}
+                               ).get(method),
+            "server_wait": (server_split.get("wait") or {}
+                            ).get(method),
+            "method": "client-observed wall per round trip over "
+                      "loopback TCP (connect + request + queue wait "
+                      "+ handler + reply), exact percentiles",
+        }
+        _emit(f"rpc p50 ms (load, {method})", row["p50_ms"], "ms",
+              None, detail)
+        _emit(f"rpc p99 ms (load, {method})", row["p99_ms"], "ms",
+              None, detail)
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=None,
@@ -1164,6 +1249,20 @@ def main() -> int:
                     help="with --fleet: measurement window per run "
                          "count (default 3.0; fleet-smoke uses a "
                          "shorter one)")
+    ap.add_argument("--load", action="store_true",
+                    help="run the serving-SLO load leg only: N "
+                         "concurrent create/attach/view/flag/destroy "
+                         "clients against an in-process fleet server "
+                         "(emits the gated per-method rpc p50/p99 ms "
+                         "lines)")
+    ap.add_argument("--load-clients", type=int, default=None,
+                    metavar="N",
+                    help="with --load: concurrent clients (default "
+                         f"{LOAD_CLIENTS})")
+    ap.add_argument("--load-cycles", type=int, default=None,
+                    metavar="N",
+                    help="with --load: cycles per client (default "
+                         f"{LOAD_CYCLES})")
     ap.add_argument("--ksweep", action="store_true",
                     help="two-point K-sweep for --size: marginal "
                          "per-turn cost + asymptotic cups + roofline")
@@ -1266,6 +1365,26 @@ def _dispatch(args, ap) -> int:
                       else FLEET_WINDOW_S))
     if args.fleet_runs or args.fleet_window is not None:
         ap.error("--fleet-runs/--fleet-window apply to the --fleet "
+                 "leg only")
+
+    if args.load:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.size is not None:
+            ap.error("--load is its own config; combine only with "
+                     "--load-clients/--load-cycles")
+        if (args.load_clients is not None and args.load_clients < 1) \
+                or (args.load_cycles is not None
+                    and args.load_cycles < 1):
+            ap.error("--load-clients/--load-cycles want positive "
+                     "integers")
+        return bench_load(
+            clients=(args.load_clients if args.load_clients
+                     else LOAD_CLIENTS),
+            cycles=(args.load_cycles if args.load_cycles
+                    else LOAD_CYCLES))
+    if args.load_clients is not None or args.load_cycles is not None:
+        ap.error("--load-clients/--load-cycles apply to the --load "
                  "leg only")
 
     if args.wire:

@@ -30,6 +30,7 @@ import numpy as np
 from gol_tpu.engine import EngineBusy, EngineKilled
 from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import flight as obs_flight
+from gol_tpu.obs import slo as obs_slo
 from gol_tpu.obs import trace
 from gol_tpu.obs.log import log as obs_log
 from gol_tpu.params import Params
@@ -126,8 +127,12 @@ class RemoteEngine:
                 obs.CLIENT_ERRORS.labels(method=label).inc()
                 raise
             finally:
+                t1 = time.monotonic()
                 obs.CLIENT_REQUEST_SECONDS.labels(method=label).observe(
-                    time.monotonic() - t0)
+                    t1 - t0)
+                # End-to-end observed latency: connect + send + server
+                # service + receive — what this caller experienced.
+                obs_slo.observe_rpc("client", label, t1 - t0, now=t1)
         self._note_caps(resp)
         _check_resp(resp)
         return resp, resp_world
@@ -242,8 +247,11 @@ class RemoteEngine:
             stop.set()
             trace.TRACER.pop(run_span)
             trace.finish(run_span)
+            t1 = time.monotonic()
             obs.CLIENT_REQUEST_SECONDS.labels(
-                method="ServerDistributor").observe(time.monotonic() - t0)
+                method="ServerDistributor").observe(t1 - t0)
+            obs_slo.observe_rpc("client", "ServerDistributor", t1 - t0,
+                                now=t1)
             try:
                 sock.close()
             except OSError:
@@ -390,6 +398,17 @@ class RemoteEngine:
                              timeout=self._timeout)
         bound = self.for_run(str(resp["run"]["run_id"]))
         return bound
+
+    def destroy_run(self, run_id: str) -> dict:
+        """Destroy a fleet run outright (resident, queued, or parked):
+        frees its bucket slot and admission budget and lets a queued
+        run promote. Returns the run's final describe() record. Raises
+        on unknown ids, the legacy default run, and single-run servers
+        (FleetUnsupported)."""
+        resp, _ = self._call({"method": "DestroyRun",
+                              "run_id": str(run_id)},
+                             timeout=self._timeout)
+        return dict(resp["run"])
 
     def for_run(self, run_id: str) -> "RemoteEngine":
         """A bound clone addressing one fleet run (no server round

@@ -72,6 +72,9 @@ class AdmissionController:
         self.committed_bytes = 0
         self.resident_runs = 0
         self.queued_runs = 0
+        # Promotion waits (seconds) since the last drain — bounded by
+        # the queue itself (every entry was a queued run).
+        self._queue_waits: list = []
 
     # ----------------------------------------------------------- budget
 
@@ -123,9 +126,23 @@ class AdmissionController:
             self.queued_runs += 1
         return True, None
 
-    def dequeue(self) -> None:
+    def dequeue(self, waited_s: Optional[float] = None) -> None:
+        """A run left the wait queue. `waited_s` (enqueue -> now) feeds
+        the queue-wait SLO aggregates; abort/removal dequeues pass
+        None — only real promotions measure the wait."""
         with self._lock:
             self.queued_runs = max(0, self.queued_runs - 1)
+            if waited_s is not None:
+                self._queue_waits.append(float(waited_s))
+
+    def drain_queue_waits(self) -> list:
+        """Hand the accumulated promotion waits (seconds) to the caller
+        and clear them — the fleet loop's batched flush feeds these to
+        the gol_fleet_queue_wait_ms estimator, so the hot path never
+        touches an estimator lock."""
+        with self._lock:
+            out, self._queue_waits = self._queue_waits, []
+        return out
 
     def release(self, cost: int) -> None:
         """Return a removed run's charge to the budget."""

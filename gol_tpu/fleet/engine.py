@@ -74,6 +74,7 @@ from gol_tpu.fleet.handles import (
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
 from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import devstats as obs_devstats
+from gol_tpu.obs import slo as obs_slo
 from gol_tpu.obs import timeline as obs_timeline
 from gol_tpu.ops.bitpack import WORD_BITS, packed_run_turns
 from gol_tpu.utils.envcfg import env_int
@@ -174,6 +175,10 @@ class FleetEngine(ControlFlagProtocol):
         self._cell_updates = 0       # board cells x turns retired
         self._dispatches = 0
         self._latency_samples: deque = deque(maxlen=8192)
+        # SLO aggregates (PR 8): bounded-memory log-bucket estimators,
+        # touched ONLY at the batched flush cadence (never per quantum).
+        self._quantum_est: Dict[str, obs_slo.LogBucketEstimator] = {}
+        self._queue_wait_est = obs_slo.LogBucketEstimator()
 
     # ------------------------------------------------------ run surface
 
@@ -267,6 +272,7 @@ class FleetEngine(ControlFlagProtocol):
                 if queue:
                     qok, qreason = self.admission.try_enqueue()
                     if qok:
+                        handle.enqueued_s = time.monotonic()
                         self._runs[run_id] = handle
                         self._waitq.append(handle)
                         self._wake.notify_all()
@@ -282,6 +288,32 @@ class FleetEngine(ControlFlagProtocol):
             self._await_placement(handle)
         with self._fleet_lock:
             return handle.describe()
+
+    def destroy_run(self, run_id: str) -> dict:
+        """Explicitly retire a fleet run: frees its bucket slot, returns
+        the admission charge (so a queued waiter can promote on the next
+        service pass), and drops the handle. Returns the run's final
+        describe() record. The legacy run0 is refused — it IS the
+        engine's single-run surface, not a fleet-created run; stop it
+        with control flags instead."""
+        self._check_alive()
+        rid = str(run_id or "")
+        with self._fleet_lock:
+            if rid in ("", LEGACY_RUN_ID):
+                raise PermissionError(
+                    f"run {LEGACY_RUN_ID!r} is the legacy engine "
+                    "surface; send QUIT/KILL control flags to stop it")
+            h = self._runs.get(rid)
+            if h is None:
+                raise KeyError(f"unknown run {rid!r}")
+            rec = h.describe()
+            self._remove_locked(h)
+            rec["state"] = h.state
+            # Capacity just freed: poke the loop so promotion/placement
+            # happens now, not at the next natural wakeup.
+            self._wake.notify_all()
+        obs.RUNS_DESTROYED.inc()
+        return rec
 
     def _resolve_rule(self, rule):
         if rule is None:
@@ -808,6 +840,10 @@ class FleetEngine(ControlFlagProtocol):
         pend_chunks = 0
         pend_turns = 0
         pend_elapsed: List[float] = []
+        # Per-bucket quantum wall latencies since the last flush (PR 8):
+        # plain local lists on the hot path, folded into the bounded
+        # log-bucket estimators only at flush time.
+        pend_quantum: Dict[str, List[float]] = {}
         overhead_accum = 0.0
         overhead_iters = 0
         last_cups = 0.0
@@ -837,6 +873,7 @@ class FleetEngine(ControlFlagProtocol):
                 obs.ENGINE_TURN.set(self._turn)
             obs.ENGINE_CHUNK_SIZE.set(self.chunk_turns)
             obs.RUNS_RESIDENT.set(self.runs_summary()["resident"])
+            self._flush_slo_locked(now, pend_quantum)
             last_flush = now
 
         while not self._killed:
@@ -872,6 +909,7 @@ class FleetEngine(ControlFlagProtocol):
                     tiles = tiles_for(h.h, h.w, bucket.hb, bucket.wb)
                     h.alive = crop_alive(int(alive_host[slot]), tiles)
                     h.alive_turn = h.turn
+                    h.advanced_s = t_done
                     useful_cells += h.h * h.w
                     top_turn = max(top_turn, h.turn)
                     if len(run_ids) < 8:
@@ -893,6 +931,8 @@ class FleetEngine(ControlFlagProtocol):
                         self._park_locked(bucket, h)
                 elapsed = t_done - t0
                 wait_s = t_done - t_disp
+                pend_quantum.setdefault(
+                    f"{bucket.hb}x{bucket.wb}", []).append(elapsed)
                 self._latency_samples.append(rotation / chunk)
                 self._board_turns += chunk * len(stepped)
                 self._cell_updates += chunk * useful_cells
@@ -927,6 +967,56 @@ class FleetEngine(ControlFlagProtocol):
                 h.done.set()
             self._wake.notify_all()
 
+    def _flush_slo_locked(self, now: float,
+                          pend_quantum: Dict[str, List[float]]) -> None:
+        """Publish the fleet SLO aggregates (fleet lock held, batched
+        flush cadence only): per-bucket serving-quantum latency
+        percentiles, admission queue depth/wait, per-run turn staleness
+        — as bounded-cardinality gauges plus the cached /healthz doc
+        (top-K worst runs by staleness, never a per-run label)."""
+        qs = (0.50, 0.95, 0.99)
+        for blabel, samples in pend_quantum.items():
+            est = self._quantum_est.get(blabel)
+            if est is None:
+                est = self._quantum_est[blabel] = \
+                    obs_slo.LogBucketEstimator()
+            est.observe_batch(samples)
+            for q, v in zip(obs.SLO_QUANTILES, est.percentiles(qs)):
+                if v is not None:
+                    obs.FLEET_QUANTUM_MS.labels(
+                        bucket=blabel, q=q).set(round(v * 1e3, 3))
+        pend_quantum.clear()
+        waits = self.admission.drain_queue_waits()
+        if waits:
+            self._queue_wait_est.observe_batch(waits)
+            for q, v in zip(obs.SLO_QUANTILES,
+                            self._queue_wait_est.percentiles(qs)):
+                if v is not None:
+                    obs.FLEET_QUEUE_WAIT_MS.labels(q=q).set(
+                        round(v * 1e3, 3))
+        obs.FLEET_QUEUE_DEPTH.set(len(self._waitq))
+        rows: List[Tuple[float, RunHandle]] = []
+        for h in self._runs.values():
+            if h.state != "resident" or h.paused:
+                continue
+            rows.append(((now - h.advanced_s) * 1e3, h))
+        doc: dict = {"resident_active": len(rows),
+                     "queue_depth": len(self._waitq),
+                     "flushed_s": round(now, 3)}
+        if rows:
+            pcts = obs_slo.exact_percentiles([ms for ms, _ in rows], qs)
+            for q, v in zip(obs.SLO_QUANTILES, pcts):
+                obs.FLEET_STALENESS_MS.labels(q=q).set(round(v, 3))
+            doc["staleness_ms"] = {
+                q: round(v, 3)
+                for q, v in zip(obs.SLO_QUANTILES, pcts)}
+            rows.sort(key=lambda r: r[0], reverse=True)
+            doc["worst_runs"] = [
+                {"run_id": h.run_id, "staleness_ms": round(ms, 1),
+                 "turn": h.turn, "state": h.state}
+                for ms, h in rows[:5]]
+        obs_slo.set_fleet_health(doc)
+
     def _next_bucket_locked(self):
         """Fair rotation: each non-empty bucket gets one quantum per
         cycle regardless of how many buckets exist or how full they
@@ -957,7 +1047,10 @@ class FleetEngine(ControlFlagProtocol):
             if not ok:
                 break
             self._waitq.popleft()
-            self.admission.dequeue()
+            # Measured promotion wait feeds the queue-wait SLO.
+            self.admission.dequeue(
+                time.monotonic() - h.enqueued_s
+                if h.enqueued_s is not None else None)
             self._placeq.append(h)
         # Placements.
         while self._placeq:
@@ -970,6 +1063,7 @@ class FleetEngine(ControlFlagProtocol):
             h.slot = bucket.place(h, board)
             h.frozen = None
             h.state = "resident"
+            h.advanced_s = time.monotonic()
         # Per-run: seeds, flags, resumes, trims/completions.
         for h in list(self._runs.values()):
             if h.state == "removed":

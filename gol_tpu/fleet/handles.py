@@ -67,7 +67,7 @@ class RunHandle:
         "alive", "alive_turn", "state", "paused", "frozen", "flags",
         "viewers", "ckpt_every", "next_ckpt_turn", "target_turn",
         "done", "created_s", "pending_seed", "ckpt_writer", "abort",
-        "admitted_cost",
+        "admitted_cost", "enqueued_s", "advanced_s",
     )
 
     def __init__(self, run_id: str, rule, h: int, w: int,
@@ -110,6 +110,13 @@ class RunHandle:
         self.abort = threading.Event()
         self.created_s = time.time()
         self.ckpt_writer = None  # lazy per-run CheckpointWriter
+        # SLO telemetry (PR 8), monotonic clock: when the run entered
+        # the admission wait queue (None = never queued), and when its
+        # board last advanced — placement stamps it, each stepped
+        # quantum restamps it, and the fleet flush derives the per-run
+        # TURN STALENESS signal (now - advanced_s) from it.
+        self.enqueued_s: Optional[float] = None
+        self.advanced_s = time.monotonic()
 
     @property
     def active(self) -> bool:
@@ -181,6 +188,11 @@ class SingleRunSurface:
         raise FleetUnsupported(
             f"{type(self).__name__} serves a single run; start the "
             "server with --fleet for CreateRun")
+
+    def destroy_run(self, *a, **kw):
+        raise FleetUnsupported(
+            f"{type(self).__name__} serves a single run; start the "
+            "server with --fleet for DestroyRun")
 
 
 def tiles_for(h: int, w: int, hb: int, wb: int) -> int:

@@ -18,7 +18,7 @@ WIRE_METHODS = (
     "ServerDistributor", "Alivecount", "GetWorld", "GetView", "GetWindow",
     "CFput", "DrainFlags", "KillProg", "Ping", "Stats", "AbortRun",
     "GetMetrics", "Checkpoint", "RestoreRun", "Profile",
-    "CreateRun", "ListRuns", "AttachRun", "unknown",
+    "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "unknown",
 )
 
 # ----------------------------------------------------------------- engine
@@ -211,6 +211,13 @@ def run_reject_label(reason: str) -> str:
     return reason if reason in RUN_REJECT_REASONS else "unknown"
 
 
+RUNS_DESTROYED = REGISTRY.counter(
+    "gol_runs_destroyed_total",
+    "DestroyRun removals: runs explicitly destroyed over the wire (or "
+    "via FleetEngine.destroy_run), freeing their bucket slot and "
+    "admission budget. QUIT/KILL-flag removals are not counted here.")
+
+
 def runs_doc() -> dict:
     """The /healthz runs summary: resident gauge + admission counters
     (registry reads only — never a device sync or an engine lock)."""
@@ -220,6 +227,60 @@ def runs_doc() -> dict:
     return {"resident": int(RUNS_RESIDENT.value),
             "admitted_total": int(RUNS_ADMITTED.value),
             "rejected_total": int(rejected)}
+
+
+# -------------------------------------------------------- serving-tier SLOs
+
+# Quantile gauges published by obs/slo.py's log-bucket estimators at the
+# r06 batched flush cadence. Cardinality is bounded by construction:
+# kinds, quantiles, and methods are all closed tuples (methods clamp via
+# method_label), and fleet bucket labels come from the configured bucket
+# sizes — never from run ids.
+RPC_KINDS = ("client", "handler", "wait")
+SLO_QUANTILES = ("p50", "p95", "p99")
+
+RPC_LATENCY_MS = REGISTRY.gauge(
+    "gol_rpc_latency_ms",
+    "RPC latency quantiles in milliseconds from the bounded-memory "
+    "log-bucket estimators (obs/slo.py; <= one ~16% bucket width of "
+    "error): kind=client (RemoteEngine end-to-end round trip), "
+    "kind=handler (server dispatch, header received -> reply sent), "
+    "kind=wait (server accept -> dispatch start: conn-slot scheduling "
+    "plus header receipt).",
+    label_names=("kind", "method", "q"))
+RPC_SLO_BREACHES = REGISTRY.counter(
+    "gol_slo_breaches_total",
+    "Flush windows in which a method's p99 exceeded the configured "
+    "GOL_SLO_P99_MS objective (0 = objective disabled); each breach "
+    "also records a flight-recorder event.",
+    label_names=("kind", "method"))
+
+FLEET_QUANTUM_MS = REGISTRY.gauge(
+    "gol_fleet_quantum_latency_ms",
+    "Serving-quantum wall latency quantiles per fleet bucket class "
+    "(dispatch issue -> popcounts home), in milliseconds.",
+    label_names=("bucket", "q"))
+FLEET_QUEUE_DEPTH = REGISTRY.gauge(
+    "gol_fleet_queue_depth",
+    "Runs currently waiting in the fleet admission queue.")
+FLEET_QUEUE_WAIT_MS = REGISTRY.gauge(
+    "gol_fleet_queue_wait_ms",
+    "Admission queue wait quantiles in milliseconds (enqueue -> "
+    "promotion to placement).",
+    label_names=("q",))
+FLEET_STALENESS_MS = REGISTRY.gauge(
+    "gol_fleet_staleness_ms",
+    "Per-run turn staleness quantiles in milliseconds across resident "
+    "unpaused runs: time since each run's board last advanced. The "
+    "top-K worst runs are named on /healthz, never as metric labels.",
+    label_names=("q",))
+
+for _k in RPC_KINDS:
+    for _q in SLO_QUANTILES:
+        RPC_LATENCY_MS.labels(kind=_k, method="unknown", q=_q)
+for _q in SLO_QUANTILES:
+    FLEET_QUEUE_WAIT_MS.labels(q=_q)
+    FLEET_STALENESS_MS.labels(q=_q)
 
 
 # ------------------------------------------------- tracing / flight recorder

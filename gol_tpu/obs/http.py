@@ -6,9 +6,11 @@ serves three read-only paths from in-process state:
   * `/metrics` (and `/`) — Prometheus text from the shared registry;
   * `/metrics.json` — the registry's dict snapshot, for tooling that
     would rather not parse exposition text;
-  * `/healthz` — 200 + `{"run_id", "turn", "uptime_s", "runs",
+  * `/healthz` — 200 + `{"run_id", "turn", "uptime_s", "runs", "slo",
     "device_kind", "live_bytes", "compile_count"}`, the liveness
-    probe ("runs" summarizes fleet residency/admissions): run_id
+    probe ("runs" summarizes fleet residency/admissions; "slo" is the
+    fleet loop's cached health doc — staleness/queue-wait percentiles
+    and the top-K worst-runs table, obs/slo.py): run_id
     identifies the process, turn proves the engine loop is advancing
     between polls, live_bytes/compile_count expose leak and
     recompile churn without a Prometheus scrape (both read the
@@ -32,6 +34,7 @@ from typing import Optional
 
 from gol_tpu.obs import catalog, devstats
 from gol_tpu.obs import flight as obs_flight
+from gol_tpu.obs import slo as obs_slo
 from gol_tpu.obs.metrics import REGISTRY
 from gol_tpu.obs.prof import PROFILER, ProfileUnavailable
 
@@ -51,6 +54,12 @@ def healthz_doc() -> dict:
            # Fleet summary (PR 7): resident/admitted/rejected run
            # counts from the registry — zeros on single-run engines.
            "runs": catalog.runs_doc()}
+    # Fleet SLO health (PR 8): the document the fleet loop's batched
+    # flush last published — staleness/queue-wait percentiles plus the
+    # top-K worst-runs table. A cached reference read, so /healthz
+    # still never takes an engine lock or syncs a device; empty dict on
+    # single-run engines.
+    doc["slo"] = obs_slo.fleet_health()
     doc.update(devstats.healthz_fields())
     return doc
 

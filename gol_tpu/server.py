@@ -26,6 +26,7 @@ from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import flight as obs_flight
 from gol_tpu.obs.log import exception as obs_exception
 from gol_tpu.obs.log import log as obs_log
+from gol_tpu.obs import slo as obs_slo
 from gol_tpu.obs import trace
 from gol_tpu.obs.metrics import REGISTRY
 from gol_tpu.params import Params
@@ -85,6 +86,12 @@ class EngineServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 break
+            # Accept timestamp: the start of the request's queue/accept
+            # wait. Everything between here and dispatch start — conn-
+            # slot acquisition, thread spawn/scheduling, header receipt
+            # — is time the CLIENT experiences but no handler explains;
+            # the SLO layer reports it as the kind="wait" split.
+            t_acc = time.monotonic()
             wire.enable_nodelay(conn)
             if (self._conn_slots is not None
                     and not self._conn_slots.acquire(blocking=False)):
@@ -105,7 +112,7 @@ class EngineServer:
                     conn.close()
                 continue
             threading.Thread(
-                target=self._serve_slot, args=(conn,), daemon=True
+                target=self._serve_slot, args=(conn, t_acc), daemon=True
             ).start()
 
     def start_background(self) -> threading.Thread:
@@ -122,27 +129,30 @@ class EngineServer:
 
     # ------------------------------------------------------------------
 
-    def _serve_slot(self, conn: socket.socket) -> None:
+    def _serve_slot(self, conn: socket.socket,
+                    t_acc: Optional[float] = None) -> None:
         try:
-            self._serve_conn(conn)
+            self._serve_conn(conn, t_acc)
         finally:
             if self._conn_slots is not None:
                 self._conn_slots.release()
 
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _serve_conn(self, conn: socket.socket,
+                    t_acc: Optional[float] = None) -> None:
         try:
             with conn:
                 if self._header_timeout > 0:
                     conn.settimeout(self._header_timeout)
                 header, world = recv_msg(conn)
                 conn.settimeout(None)  # dispatch may compute for hours
-                self._dispatch(conn, header, world)
+                self._dispatch(conn, header, world, t_acc)
         except (ConnectionError, OSError, ValueError):
             # includes socket.timeout (OSError): idle client shed
             pass
 
     def _dispatch(
-        self, conn: socket.socket, header: dict, world
+        self, conn: socket.socket, header: dict, world,
+        t_acc: Optional[float] = None,
     ) -> None:
         method = header.get("method")
         # Request accounting brackets the whole dispatch, reply
@@ -152,6 +162,11 @@ class EngineServer:
         label = obs.method_label(str(method))
         obs.SERVER_REQUESTS.labels(method=label).inc()
         t0 = time.monotonic()
+        if t_acc is not None:
+            # Queue/accept-wait split: accept() -> dispatch start. The
+            # complement of the handler time below — together they tile
+            # the server side of the client's observed round trip.
+            obs_slo.observe_rpc("wait", label, t0 - t_acc, now=t0)
         # The handler span joins the caller's trace via the propagated
         # "tc" header (absent/garbage → a fresh root). It sits on this
         # connection thread's context stack for the whole dispatch, so
@@ -161,8 +176,10 @@ class EngineServer:
             try:
                 self._dispatch_inner(conn, method, label, header, world)
             finally:
+                t1 = time.monotonic()
                 obs.SERVER_REQUEST_SECONDS.labels(method=label).observe(
-                    time.monotonic() - t0)
+                    t1 - t0)
+                obs_slo.observe_rpc("handler", label, t1 - t0, now=t1)
 
     def _reply(self, conn: socket.socket, header: dict, world=None,
                frame=None) -> None:
@@ -348,6 +365,15 @@ class EngineServer:
                     str(header.get("run_id") or ""))
                 self._reply(conn, {"ok": True,
                                    "run": surf.describe_run()})
+            elif method == "DestroyRun":
+                # Explicit slot release (vs QUIT/KILL flags): removes
+                # the run wherever it sits (resident, queued, parked),
+                # frees its admission budget, and wakes the loop so a
+                # queued run promotes immediately. Single-run engines
+                # answer FleetUnsupported, same as CreateRun.
+                rec = self.engine.destroy_run(
+                    str(header.get("run_id") or ""))
+                self._reply(conn, {"ok": True, "run": rec})
             elif method == "RestoreRun":
                 turn = self._restore_run(str(header.get("path", "")))
                 self._reply(conn, {"ok": True, "turn": turn})

@@ -68,7 +68,9 @@ Fleet runs (PR 7): `CreateRun` {"h", "w", "rule"?, "run_id"?,
 admits a new resident run on a fleet server and replies {"run_id",
 "state", "bucket", "turn"}; `ListRuns` replies {"runs": [...],
 "summary": {...}}; `AttachRun` {"run_id"} replies that run's
-description. Every run-scoped method (`GetWorld`, `GetView`,
+description; `DestroyRun` {"run_id"} (PR 8) retires a fleet run,
+releasing its admission budget so a queued waiter can promote, and
+replies the run's final record. Every run-scoped method (`GetWorld`, `GetView`,
 `Alivecount`, `CFput`, `DrainFlags`, `Checkpoint`, `Stats`,
 `RestoreRun`) accepts an optional `"run_id"` header key routing it to
 one resident run; a missing run_id means the legacy default run, so

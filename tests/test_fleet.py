@@ -22,9 +22,15 @@ import pytest
 from gol_tpu import wire
 from gol_tpu.client import RemoteEngine
 from gol_tpu.engine import FLAG_KILL, FLAG_PAUSE, Engine
-from gol_tpu.fleet import AdmissionController, FleetEngine, run_cost
+from gol_tpu.fleet import (
+    AdmissionController,
+    FleetEngine,
+    FleetUnsupported,
+    run_cost,
+)
 from gol_tpu.models import CONWAY
 from gol_tpu.obs import catalog as obs_cat
+from gol_tpu.obs import slo as obs_slo
 from gol_tpu.obs import devstats
 from gol_tpu.ops.bitpack import (
     pack_np,
@@ -140,6 +146,52 @@ def test_admission_rejects_and_queue_drains():
         assert eng.resolve_run("d").stats()["state"] == "resident"
     finally:
         eng.kill_prog()
+
+
+def test_destroy_run_frees_slot_and_promotes_queued():
+    """DestroyRun (PR 8) is the explicit retirement path: it returns
+    the final record with state="removed", meters the destroy counter,
+    releases the admission charge, and the freed budget promotes a
+    queued waiter without any control-flag round-trip."""
+    cost = run_cost(64, 2)
+    eng = FleetEngine(bucket_sizes=(64,), chunk_turns=2, slot_base=2,
+                      admission=AdmissionController(budget_bytes=2 * cost))
+    try:
+        eng.create_run(64, 64, run_id="a")
+        eng.create_run(64, 64, run_id="b")
+        rec = eng.create_run(64, 64, run_id="q", queue=True)
+        assert rec["state"] == "queued"
+        destroyed0 = obs_cat.RUNS_DESTROYED.value
+        final = eng.destroy_run("a")
+        assert final["run_id"] == "a" and final["state"] == "removed"
+        assert obs_cat.RUNS_DESTROYED.value == destroyed0 + 1
+        with pytest.raises(KeyError, match="unknown run"):
+            eng.resolve_run("a")
+        _wait(lambda: eng.runs_summary()["resident"] == 2 and
+              eng.runs_summary()["queued"] == 0,
+              what="queued run to promote after destroy")
+        assert eng.resolve_run("q").stats()["state"] == "resident"
+        # the promotion wait reaches the SLO queue-wait gauge at the
+        # next fleet flush (log-bucket floor makes any wait >= 0.05ms)
+        _wait(lambda: obs_cat.FLEET_QUEUE_WAIT_MS.labels(q="p50").value
+              > 0, what="queue-wait percentile gauge to publish")
+    finally:
+        eng.kill_prog()
+
+
+def test_destroy_run_refuses_legacy_and_unknown(fleet):
+    """run0 is the legacy engine surface (stop it with control flags,
+    not DestroyRun); unknown ids keep the standard KeyError shape."""
+    for legacy in ("run0", ""):
+        with pytest.raises(PermissionError, match="legacy"):
+            fleet.destroy_run(legacy)
+    with pytest.raises(KeyError, match="unknown run"):
+        fleet.destroy_run("nope")
+
+
+def test_single_run_surface_refuses_destroy():
+    with pytest.raises(FleetUnsupported, match="--fleet"):
+        Engine().destroy_run("anything")
 
 
 def test_admission_rejects_misfit_shape_and_hostile_run_id(fleet):
@@ -285,6 +337,27 @@ def test_healthz_runs_summary_tracks_admissions():
         eng.kill_prog()
 
 
+def test_fleet_health_doc_tracks_staleness_and_worst_runs(fleet):
+    """The /healthz "slo" doc (PR 8): bounded-cardinality fleet health
+    flushed from the serving loop — resident count, queue depth,
+    staleness percentiles, and a top-K worst-runs table that names
+    run ids WITHOUT minting per-run metric labels."""
+    fleet.create_run(64, 64, run_id="hdoc")
+    # the cache is global and another engine's doc may linger until
+    # OUR loop's next 0.5s flush: wait for this run to appear in it
+    _wait(lambda: [r["run_id"] for r in
+                   (obs_slo.fleet_health() or {}).get("worst_runs", [])]
+          == ["hdoc"], what="fleet health doc to flush this run")
+    doc = obs_slo.fleet_health()
+    assert doc["resident_active"] == 1
+    assert doc["queue_depth"] == 0
+    assert set(doc["staleness_ms"]) == set(obs_cat.SLO_QUANTILES)
+    assert [r["run_id"] for r in doc["worst_runs"]] == ["hdoc"]
+    assert doc["worst_runs"][0]["staleness_ms"] >= 0
+    # the same staleness percentiles land on the bounded gauge family
+    assert obs_cat.FLEET_STALENESS_MS.labels(q="p99").value >= 0
+
+
 # ------------------------------------------------- wire interop (legacy)
 
 
@@ -355,3 +428,20 @@ def test_wire_create_list_attach_and_run_scoped_fetch(fleet_server):
 
     with pytest.raises(RuntimeError, match="unknown run"):
         cli.attach_run("nope")
+
+
+def test_wire_destroy_run_roundtrip_and_errors(fleet_server):
+    """DestroyRun over the wire: returns the final record, the run
+    leaves ListRuns, re-destroy keeps the unknown-run error shape, and
+    the legacy run0 refusal surfaces as a denied: error."""
+    cli = RemoteEngine(f"127.0.0.1:{fleet_server.port}")
+    cli.create_run(64, 64, board=_soup(64, 64, seed=31) * np.uint8(255),
+                   run_id="d1", target_turn=4)
+    final = cli.destroy_run("d1")
+    assert final["run_id"] == "d1" and final["state"] == "removed"
+    runs, _ = cli.list_runs()
+    assert not any(r["run_id"] == "d1" for r in runs)
+    with pytest.raises(RuntimeError, match="unknown run"):
+        cli.destroy_run("d1")
+    with pytest.raises(RuntimeError, match="denied"):
+        cli.destroy_run("run0")

@@ -189,6 +189,92 @@ def test_overhead_unit_is_lower_is_better():
         "turns/sec (512x512, full engine stack)", "turns/s")
 
 
+def test_percentile_names_are_lower_is_better():
+    """PR 8 gate direction: a pXX token or ms suffix in the metric NAME
+    marks a latency quantity even when the unit field is missing —
+    and must not swallow throughput-flavoured names."""
+    assert not perf_compare._higher_is_better(
+        "rpc p99 ms (load, CreateRun)", "ms")
+    assert not perf_compare._higher_is_better(
+        "rpc p50 ms (load, GetView)", None)  # name alone decides
+    assert not perf_compare._higher_is_better("queue_wait_ms", None)
+    assert not perf_compare._higher_is_better(
+        "gol_fleet_staleness_ms p95", None)
+    assert perf_compare._higher_is_better(
+        "aggregate cell-updates/sec (fleet, 64 x 512x512 runs)",
+        "cell-updates/s")
+    assert perf_compare._higher_is_better(
+        "snapshot MB/s (512x512 loopback)", "MB/s")
+
+
+def test_load_metrics_match_default_gate_pattern():
+    """The rpc p50/p99 load metrics must be GATED by default, so
+    `make load-smoke` can actually fail."""
+    import re
+
+    gate_re = re.compile(perf_compare.DEFAULT_GATE_PATTERN)
+    assert gate_re.search("rpc p50 ms (load, CreateRun)")
+    assert gate_re.search("rpc p99 ms (load, DestroyRun)")
+    assert not gate_re.search("rpc served bytes (load, GetView)")
+
+
+def test_gate_covers_both_directions_for_latency(tmp_path):
+    """End-to-end on a percentile metric: a candidate ABOVE the ms
+    ceiling fails, one below passes — the mirror of the throughput
+    direction asserted below."""
+    base = str(tmp_path / "BASELINE.json")
+    good = str(tmp_path / "good.jsonl")
+    bad = str(tmp_path / "bad.jsonl")
+    metric = "rpc p99 ms (load, CreateRun)"
+    _baseline(base, 1000.0, unit="ms", metric=metric)
+    _candidate(good, 12.0, unit="ms", metric=metric)
+    _candidate(bad, 5000.0, unit="ms", metric=metric)
+    assert perf_compare.main([base, good]) == 0
+    assert perf_compare.main([base, bad]) == 1
+
+
+def test_gate_covers_both_directions_for_throughput(tmp_path):
+    """And the throughput mirror: a drop fails, a raise passes."""
+    base = str(tmp_path / "BASELINE.json")
+    good = str(tmp_path / "good.jsonl")
+    bad = str(tmp_path / "bad.jsonl")
+    _baseline(base, 5_000_000.0)
+    _candidate(good, 6_000_000.0)
+    _candidate(bad, 1_000_000.0)
+    assert perf_compare.main([base, good]) == 0
+    assert perf_compare.main([base, bad]) == 1
+
+
+def test_audit_rejects_unwaivered_latency_ceiling_raise(tmp_path,
+                                                        capsys):
+    """Baseline integrity for lower-is-better entries: RAISING a
+    latency ceiling is the loosening direction and needs a waiver —
+    the exact mirror of lowering a throughput anchor."""
+    prev = str(tmp_path / "prev.json")
+    cur = str(tmp_path / "BASELINE.json")
+    cand = str(tmp_path / "cand.jsonl")
+    metric = "rpc p99 ms (load, CreateRun)"
+    _baseline(prev, 1000.0, unit="ms", metric=metric)
+    _baseline(cur, 5000.0, unit="ms", metric=metric)  # loosened
+    _candidate(cand, 12.0, unit="ms", metric=metric)
+    rc = perf_compare.main([cur, cand, "--baseline-prev", prev])
+    assert rc == 1
+    assert "no waiver" in capsys.readouterr().out
+
+
+def test_audit_allows_tightened_latency_ceiling(tmp_path):
+    """Tightening a latency ceiling is the improving direction — no
+    waiver needed."""
+    prev = str(tmp_path / "prev.json")
+    cur = str(tmp_path / "BASELINE.json")
+    cand = str(tmp_path / "cand.jsonl")
+    metric = "rpc p99 ms (load, CreateRun)"
+    _baseline(prev, 1000.0, unit="ms", metric=metric)
+    _baseline(cur, 500.0, unit="ms", metric=metric)
+    _candidate(cand, 12.0, unit="ms", metric=metric)
+    assert perf_compare.main([cur, cand, "--baseline-prev", prev]) == 0
+
+
 def test_gate_fails_on_overhead_growth(tmp_path, capsys):
     """End-to-end: a candidate whose chunk_overhead_us EXCEEDS the
     baseline ceiling fails the gate (lower-is-better + gated

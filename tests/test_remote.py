@@ -148,12 +148,12 @@ def test_attach_drainflags_error_still_delivers_close(images_dir, out_dir,
     from gol_tpu.wire import send_msg as _send
 
     class BrokenDrainServer(EngineServer):
-        def _dispatch(self, conn, header, world):
+        def _dispatch(self, conn, header, world, t_acc=None):
             if header.get("method") == "DrainFlags":
                 _send(conn, {"ok": False,
                              "error": "NameError: name 'req' is not defined"})
                 return
-            super()._dispatch(conn, header, world)
+            super()._dispatch(conn, header, world, t_acc)
 
     monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
     srv = BrokenDrainServer(port=0, host="127.0.0.1", engine=Engine())

@@ -1,0 +1,209 @@
+"""Serving-tier SLO layer (gol_tpu/obs/slo.py): the log-bucket
+quantile estimator's one-bucket-width error bound against exact sample
+percentiles on adversarial distributions, out-of-range clamping,
+batch/loop equivalence and thread safety, the handler-vs-queue-wait
+latency split measured through a live server, SLO-breach metering into
+the flight recorder, and a small-N load-generator run against a live
+fleet server (the tier-1 face of `make load-smoke`)."""
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from gol_tpu.client import RemoteEngine
+from gol_tpu.fleet import FleetEngine
+from gol_tpu.obs import catalog as obs_cat
+from gol_tpu.obs import flight as obs_flight
+from gol_tpu.obs import slo
+from gol_tpu.server import EngineServer
+from tools import load_smoke
+
+
+@pytest.fixture
+def slo_state():
+    """Scope the module-global estimator state to one test."""
+    slo.reset()
+    yield
+    slo.reset()
+
+
+@pytest.fixture
+def fleet_server(monkeypatch):
+    monkeypatch.setenv("GOL_SERVER_EXIT_ON_KILL", "0")
+    srv = EngineServer(port=0, host="127.0.0.1",
+                       engine=FleetEngine(bucket_sizes=(64,),
+                                          chunk_turns=2, slot_base=8))
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+# ------------------------------------------------- estimator error bound
+
+
+def _adversarial_distributions():
+    rng = np.random.default_rng(7)
+    return {
+        "uniform": rng.uniform(1e-4, 1.0, 5000),
+        # heavy tail: p99 lives far from the mass
+        "lognormal": np.exp(rng.normal(-6.0, 2.0, 5000)),
+        # bimodal: fast path + slow path, nothing in between
+        "bimodal": np.concatenate([rng.uniform(1e-4, 3e-4, 4500),
+                                   rng.uniform(0.5, 1.0, 500)]),
+        "constant": np.full(1000, 0.0123),
+        "two-sample": np.array([2e-3, 0.2]),
+    }
+
+
+@pytest.mark.parametrize("name,values",
+                         sorted(_adversarial_distributions().items()))
+def test_estimator_within_one_bucket_width(name, values):
+    """The load-bearing claim: for in-range samples the reported
+    quantile brackets the exact sample quantile from above by at most
+    one geometric bucket width (ratio ~1.158)."""
+    est = slo.LogBucketEstimator()
+    est.observe_batch(values)
+    qs = (0.50, 0.95, 0.99)
+    exact = slo.exact_percentiles(values, qs)
+    got = est.percentiles(qs)
+    for q, e, g in zip(qs, exact, got):
+        assert e <= g <= e * est.ratio * (1 + 1e-12), \
+            f"{name} p{int(q * 100)}: exact={e} est={g} ratio={est.ratio}"
+
+
+def test_estimator_clamps_out_of_range():
+    """Below-lo samples report the first bucket's upper edge, above-hi
+    the hi edge — ordered, but located only to the range boundary."""
+    est = slo.LogBucketEstimator()
+    est.observe_batch([1e-9] * 10)
+    assert est.percentile(0.5) == pytest.approx(est.lo * est.ratio)
+    est2 = slo.LogBucketEstimator()
+    est2.observe_batch([1e9] * 10)
+    assert est2.percentile(0.99) == est2.hi
+    # NaN and negatives land in bucket 0 instead of corrupting state
+    est3 = slo.LogBucketEstimator()
+    est3.observe(float("nan"))
+    est3.observe(-1.0)
+    assert est3.count == 2
+    assert est3.percentile(0.5) == pytest.approx(est3.lo * est3.ratio)
+
+
+def test_estimator_batch_matches_loop_and_reset():
+    vals = [1e-3, 5e-3, 0.2, 7.0, 1e-5]
+    a, b = slo.LogBucketEstimator(), slo.LogBucketEstimator()
+    a.observe_batch(vals)
+    for v in vals:
+        b.observe(v)
+    assert a.snapshot() == b.snapshot()
+    assert a.count == len(vals)
+    a.reset()
+    assert a.count == 0 and a.sum == 0.0
+    assert a.percentiles((0.5, 0.99)) == (None, None)
+
+
+def test_estimator_concurrent_observers_lose_nothing():
+    est = slo.LogBucketEstimator()
+    n, threads = 2000, 8
+
+    def work(seed):
+        for i in range(n):
+            est.observe(1e-3 * (1 + (seed * n + i) % 50))
+
+    ts = [threading.Thread(target=work, args=(s,)) for s in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert est.count == n * threads
+    assert sum(est._counts) == n * threads
+
+
+def test_exact_percentiles_rank_semantics():
+    assert slo.exact_percentiles([], (0.5,)) == (None,)
+    vals = list(range(1, 101))  # 1..100
+    assert slo.exact_percentiles(vals, (0.50, 0.95, 0.99, 1.0)) \
+        == (50, 95, 99, 100)
+    assert slo.exact_percentiles([3.0], (0.5, 0.99)) == (3.0, 3.0)
+
+
+# ---------------------------------------------- rpc split through a server
+
+
+def test_handler_wait_client_split_on_live_server(fleet_server,
+                                                  slo_state):
+    """Every wire method reports three latency kinds: client (remote
+    round trip), handler (dispatch only), wait (accept -> dispatch).
+    All three must see the same Ping traffic, and the server-side
+    handler time cannot exceed the client-observed round trip."""
+    cli = RemoteEngine(f"127.0.0.1:{fleet_server.port}")
+    for _ in range(8):
+        cli.ping()
+    slo.flush()
+    snap = slo.rpc_snapshot()
+    for kind in obs_cat.RPC_KINDS:
+        assert snap[kind]["Ping"]["count"] >= 8, \
+            f"kind={kind} missed the Ping traffic: {snap.get(kind)}"
+    # handler is a strict slice of the client round trip; one bucket
+    # width of estimator slack on each side
+    ratio = slo.LogBucketEstimator().ratio
+    assert snap["handler"]["Ping"]["p50"] \
+        <= snap["client"]["Ping"]["p50"] * ratio
+    for kind in obs_cat.RPC_KINDS:
+        for q in obs_cat.SLO_QUANTILES:
+            assert obs_cat.RPC_LATENCY_MS.labels(
+                kind=kind, method="Ping", q=q).value > 0.0
+
+
+def test_breach_meters_counter_and_flight_event(slo_state, monkeypatch):
+    """With a 1ms p99 objective, a 500ms sample breaches at flush:
+    counter increments and a structured slo.breach event lands in the
+    flight-recorder ring (no dump — that stays operator-opted-in)."""
+    monkeypatch.setenv(slo.SLO_P99_ENV, "1.0")
+    breach0 = obs_cat.RPC_SLO_BREACHES.labels(kind="client",
+                                              method="Ping").value
+    slo.observe_rpc("client", "Ping", 0.5, now=0.0)  # no auto-flush
+    slo.flush()
+    assert obs_cat.RPC_SLO_BREACHES.labels(
+        kind="client", method="Ping").value == breach0 + 1
+    evs = [e for e in obs_flight.FLIGHT.snapshot("test")["events"]
+           if e.get("event") == "slo.breach"]
+    assert evs, "no slo.breach event in the flight ring"
+    last = evs[-1]
+    assert last["kind"] == "client" and last["method"] == "Ping"
+    assert last["p99_ms"] > last["objective_ms"] == 1.0
+    # an idle window re-breaches nothing (change-detection on count)
+    slo.flush()
+    assert obs_cat.RPC_SLO_BREACHES.labels(
+        kind="client", method="Ping").value == breach0 + 1
+
+
+def test_hostile_method_names_clamp_to_unknown(slo_state):
+    slo.observe_rpc("client", "EvilMethod'; DROP", 1e-3, now=0.0)
+    snap = slo.rpc_snapshot()
+    assert list(snap["client"]) == ["unknown"]
+
+
+# -------------------------------------------------- load generator, small-N
+
+
+def test_load_smoke_small_n_against_live_fleet(fleet_server):
+    """Tier-1 face of `make load-smoke`: two clients, two full
+    create/attach/view/flag/destroy cycles each, zero errors, every
+    method sampled, and the summary emits positive p50/p99."""
+    res = load_smoke.run_load(f"127.0.0.1:{fleet_server.port}",
+                              clients=2, cycles=2, board=64,
+                              view_cells=1024)
+    assert res["errors"] == []
+    for method in load_smoke.CYCLE_METHODS:
+        assert len(res["samples"][method]) == 4, \
+            f"{method}: {len(res['samples'][method])} samples"
+    summary = load_smoke.summarize(res["samples"])
+    for method, row in summary.items():
+        assert row["count"] == 4
+        assert 0.0 < row["p50_ms"] <= row["p99_ms"] <= row["max_ms"]
+    # the fleet is clean afterwards: every cycle destroyed its run
+    eng = fleet_server.engine if hasattr(fleet_server, "engine") else None
+    if eng is not None:
+        assert eng.runs_summary()["resident"] == 0

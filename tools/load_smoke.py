@@ -1,0 +1,194 @@
+"""load-smoke: a small concurrent load generator for a fleet server.
+
+Drives N client threads against a live wire server, each looping the
+canonical serving cycle — CreateRun -> AttachRun -> GetView -> CFput
+(pause) -> DestroyRun — and recording the client-observed wall latency
+of every call, per method. The numbers come from the caller's own
+clock (time.monotonic around each round trip), so they are END-TO-END:
+connect + request + server queue/accept wait + handler + reply.
+
+Two consumers:
+
+  * `bench.py --load` imports `run_load` to produce the gated
+    `rpc p50/p99 ms (load, <Method>)` metrics against an in-process
+    fleet server (see `make load-smoke`);
+  * standalone, it load-tests ANY reachable server:
+
+        python tools/load_smoke.py --address host:8765 --clients 8
+
+    With no --address it starts a private in-process fleet server on
+    an ephemeral port, which makes the zero-argument invocation a
+    self-contained smoke (exit 0 = every cycle completed, nonzero on
+    any error).
+
+Kept deliberately small-N by default: the point is exercising the
+serving path's SLO instrumentation honestly, not saturating a laptop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Runnable as `python tools/load_smoke.py` from a bare clone: put the
+# repo root (this file's parent's parent) ahead of tools/ on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# The cycle's methods, in call order (also the report ordering).
+CYCLE_METHODS = ("CreateRun", "AttachRun", "GetView", "CFput",
+                 "DestroyRun")
+
+
+def _worker(address: str, worker_id: int, cycles: int, board: int,
+            view_cells: int, timeout: float,
+            samples: Dict[str, List[float]], errors: List[str],
+            lock: threading.Lock) -> None:
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import FLAG_PAUSE
+
+    eng = RemoteEngine(address, timeout=timeout)
+    local: Dict[str, List[float]] = {m: [] for m in CYCLE_METHODS}
+    for cycle in range(cycles):
+        try:
+            t0 = time.monotonic()
+            rec = eng.create_run(board, board)
+            local["CreateRun"].append(time.monotonic() - t0)
+            rid = rec["run_id"]
+
+            t0 = time.monotonic()
+            bound = eng.attach_run(rid)
+            local["AttachRun"].append(time.monotonic() - t0)
+
+            t0 = time.monotonic()
+            bound.get_view(view_cells)
+            local["GetView"].append(time.monotonic() - t0)
+
+            t0 = time.monotonic()
+            bound.cf_put(FLAG_PAUSE)
+            local["CFput"].append(time.monotonic() - t0)
+
+            t0 = time.monotonic()
+            eng.destroy_run(rid)
+            local["DestroyRun"].append(time.monotonic() - t0)
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            with lock:
+                errors.append(
+                    f"worker {worker_id} cycle {cycle}: "
+                    f"{type(e).__name__}: {e}")
+            return
+    with lock:
+        for m, vals in local.items():
+            samples.setdefault(m, []).extend(vals)
+
+
+def run_load(address: str, *, clients: int = 4, cycles: int = 8,
+             board: int = 64, view_cells: int = 4096,
+             timeout: float = 30.0) -> dict:
+    """Drive `clients` concurrent cycle loops against `address`.
+
+    Returns {"samples": {method: [seconds, ...]}, "errors": [...],
+    "clients": N, "cycles": M, "wall_s": total}. A worker stops its
+    remaining cycles on the first error (recorded in "errors"), so a
+    clean run has exactly clients*cycles samples per method.
+    """
+    samples: Dict[str, List[float]] = {}
+    errors: List[str] = []
+    lock = threading.Lock()
+    threads = [
+        threading.Thread(
+            target=_worker,
+            args=(address, i, cycles, board, view_cells, timeout,
+                  samples, errors, lock),
+            name=f"gol-load-{i}", daemon=True)
+        for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout * cycles * len(CYCLE_METHODS))
+    return {"samples": samples, "errors": errors, "clients": clients,
+            "cycles": cycles, "wall_s": round(time.monotonic() - t0, 3)}
+
+
+def summarize(samples: Dict[str, List[float]]) -> Dict[str, dict]:
+    """{method: {count, p50_ms, p99_ms, max_ms}} via exact percentiles
+    (small populations — no need for the streaming estimator here)."""
+    from gol_tpu.obs import slo
+
+    out: Dict[str, dict] = {}
+    for method in CYCLE_METHODS:
+        vals = samples.get(method) or []
+        if not vals:
+            continue
+        p50, p99 = slo.exact_percentiles(vals, (0.50, 0.99))
+        out[method] = {"count": len(vals),
+                       "p50_ms": round(p50 * 1e3, 3),
+                       "p99_ms": round(p99 * 1e3, 3),
+                       "max_ms": round(max(vals) * 1e3, 3)}
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="concurrent create/attach/view/flag/destroy load "
+                    "against a fleet server")
+    ap.add_argument("--address", default="",
+                    help="host:port of a running server (default: "
+                         "start a private in-process fleet server)")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--cycles", type=int, default=8,
+                    help="cycles per client (default 8)")
+    ap.add_argument("--board", type=int, default=64,
+                    help="square board side per run (default 64)")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args(argv)
+
+    server = engine = None
+    address = args.address
+    if not address:
+        from gol_tpu.fleet.engine import FleetEngine
+        from gol_tpu.server import EngineServer
+
+        engine = FleetEngine(bucket_sizes=(64,), chunk_turns=2,
+                             slot_base=8)
+        server = EngineServer(port=0, host="127.0.0.1", engine=engine)
+        server.start_background()
+        address = f"127.0.0.1:{server.port}"
+    try:
+        result = run_load(address, clients=args.clients,
+                          cycles=args.cycles, board=args.board,
+                          timeout=args.timeout)
+    finally:
+        if engine is not None:
+            engine.kill_prog()
+        if server is not None:
+            server.shutdown()
+    table = summarize(result["samples"])
+    print(json.dumps({"address": address, "wall_s": result["wall_s"],
+                      "clients": result["clients"],
+                      "cycles": result["cycles"], "methods": table,
+                      "errors": result["errors"]}, sort_keys=True))
+    if result["errors"]:
+        for e in result["errors"]:
+            print(f"load-smoke: FAIL: {e}", file=sys.stderr)
+        return 1
+    missing = [m for m in CYCLE_METHODS if m not in table]
+    if missing:
+        print(f"load-smoke: FAIL: no samples for {missing}",
+              file=sys.stderr)
+        return 1
+    print(f"load-smoke: OK — {args.clients} client(s) x "
+          f"{args.cycles} cycle(s) in {result['wall_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

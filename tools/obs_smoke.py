@@ -120,7 +120,21 @@ def main() -> int:
                        "# TYPE gol_wire_encode_seconds histogram",
                        "# TYPE gol_wire_decode_seconds histogram",
                        'gol_wire_frames_total{codec="packed"}',
-                       'gol_wire_frames_total{codec="xrle"}'):
+                       'gol_wire_frames_total{codec="xrle"}',
+                       # PR 8 serving-SLO families (pre-seeded in the
+                       # catalog, so they expose even before traffic)
+                       "# TYPE gol_rpc_latency_ms gauge",
+                       "# TYPE gol_slo_breaches_total counter",
+                       "# TYPE gol_fleet_quantum_latency_ms gauge",
+                       "# TYPE gol_fleet_queue_depth gauge",
+                       "# TYPE gol_fleet_queue_wait_ms gauge",
+                       "# TYPE gol_fleet_staleness_ms gauge",
+                       "# TYPE gol_runs_destroyed_total counter",
+                       'gol_rpc_latency_ms{kind="client",'
+                       'method="unknown",q="p50"}',
+                       'gol_rpc_latency_ms{kind="handler",'
+                       'method="unknown",q="p99"}',
+                       'gol_fleet_queue_wait_ms{q="p95"}'):
             if needle not in body:
                 problems.append(f"/metrics missing {needle!r}")
         if 'gol_profile_captures_total{status="ok"} 1' not in body:
@@ -135,7 +149,8 @@ def main() -> int:
         base_url = srv.url.rsplit("/", 1)[0]
         healthz = json.loads(urllib.request.urlopen(
             base_url + "/healthz", timeout=10).read().decode())
-        for field in ("device_kind", "live_bytes", "compile_count"):
+        for field in ("device_kind", "live_bytes", "compile_count",
+                      "runs", "slo"):
             if field not in healthz:
                 problems.append(f"/healthz missing {field!r}")
         if healthz.get("device_kind") != "cpu":

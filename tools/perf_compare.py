@@ -18,13 +18,14 @@ flags needed — these are every perf artifact this repo produces):
       {metric: value | {"value": ..., "unit": ...}}}
 
 Delta semantics: rate metrics (unit ending "/s", or "/sec" in the
-name) are higher-is-better; "seconds"/"s"/"us"/"ms"-unit metrics and
-overhead/latency-named metrics are lower-is-better. Deltas inside the
-noise floor (default 5%) are reported but never gate. A regression
-beyond --max-regression (default 10%) on any GATED metric (those
-matching --gate-pattern, default
-"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us") fails
-the run.
+name) are higher-is-better; "seconds"/"s"/"us"/"ms"-unit metrics,
+overhead/latency-named metrics, and percentile-named metrics (a
+p50/p95/p99 token or a trailing ms/us suffix in the name) are
+lower-is-better. Deltas inside the noise floor (default 5%) are
+reported but never gate. A regression beyond --max-regression
+(default 10%) on any GATED metric (those matching --gate-pattern,
+default "cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us|
+rpc p\\d+ ms") fails the run.
 
 Baseline-integrity audit (PR 6): when the baseline file is a
 BASELINE.json, the tool also diffs it against its previous git
@@ -60,7 +61,8 @@ Metrics = Dict[str, Tuple[float, Optional[str]]]
 DEFAULT_NOISE_FLOOR = 5.0
 DEFAULT_MAX_REGRESSION = 10.0
 DEFAULT_GATE_PATTERN = (
-    r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us")
+    r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us"
+    r"|rpc p\d+ ms")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
@@ -160,6 +162,14 @@ def _higher_is_better(metric: str, unit: Optional[str]) -> bool:
     # better and the gate would reward the regression it exists to catch.
     low = metric.lower()
     if "overhead" in low or "latency" in low:
+        return False
+    # Percentile / time-suffixed names (the PR 8 load-leg metrics are
+    # "rpc p50 ms (load, CreateRun)"-shaped): a pXX token or a trailing
+    # ms/us/s suffix marks a latency quantity — lower is better even
+    # when the unit field went missing in transit.
+    if re.search(r"(^|[^a-z0-9])p(50|90|95|99)([^a-z0-9]|$)", low):
+        return False
+    if low.endswith("_ms") or low.endswith("_us") or low.endswith(" ms"):
         return False
     return True  # throughput-flavoured by default
 
