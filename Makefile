@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -60,8 +60,17 @@ load-smoke:
 		| tee out/load_smoke.jsonl
 	python tools/perf_compare.py BASELINE.json out/load_smoke.jsonl
 
+# Multi-device scaling telemetry check, CPU-only with 8 forced host
+# devices: one 4-way bench.py --mesh leg in-process, validating the
+# gol_mesh_*/gol_halo_*/imbalance families, the /healthz mesh stamp,
+# and the BASELINE.json scaling_efficiency_pct / halo_overlap_pct
+# floors (higher is better) via tools/perf_compare.py
+# (tools/mesh_smoke.py).
+mesh-smoke:
+	JAX_PLATFORMS=cpu python tools/mesh_smoke.py
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

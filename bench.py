@@ -429,11 +429,16 @@ def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
     import jax
 
     from gol_tpu.parallel.halo import select_representation
-    from gol_tpu.parallel.mesh import make_mesh, resolve_shard_count
+    from gol_tpu.parallel.mesh import (
+        make_mesh,
+        mesh_geometry,
+        resolve_shard_count,
+    )
     from gol_tpu.utils.sync import wait
 
     n_shards = resolve_shard_count(n, len(jax.devices()))
     mesh = make_mesh(n_shards)
+    mesh_geom = mesh_geometry(mesh)
     packed, sharded_run_turns = select_representation(n)
     cells, fixture_board = _dense_board(n, mesh, packed, try_fixture=True)
 
@@ -455,7 +460,11 @@ def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
     detail = {
         "size": n, "turns": turns, "elapsed_s": round(elapsed, 4),
         "turns_per_s": round(turns / elapsed, 1),
-        "devices": len(jax.devices()), "shards": n_shards,
+        # True geometry of the mesh the leg actually ran on (the old
+        # `len(jax.devices())` answered "how many devices exist", not
+        # "how many this board was sharded over").
+        "devices": mesh_geom["devices"], "shards": mesh_geom["shards"],
+        "mesh_shape": mesh_geom["shape"], "mesh_axes": mesh_geom["axes"],
         "packed": packed, "alive_parity": parity,
         "parity_check": parity_how,
         "baseline_cups_estimate": BASELINE_CUPS if n == 512 else None,
@@ -477,6 +486,173 @@ def bench_dense(n: int, turns: int, warmup_turns: int) -> int:
         detail,
     )
     return 0 if parity is not False else 1
+
+
+# --mesh leg sizing. Strong scaling holds one 1024² board fixed while
+# the mesh widens; weak scaling holds 256 rows/device so the per-shard
+# work is constant. 2048 turns is a multiple of every macro depth the
+# deep-halo path picks here (T ≤ 32), keeps each timed call long enough
+# that dispatch latency is noise, and stays small enough that the full
+# 2/4/8-way matrix finishes in seconds even on a CPU host with forced
+# virtual devices.
+MESH_WAYS = (2, 4, 8)
+MESH_TURNS = 2048
+MESH_STRONG_N = 1024
+MESH_WEAK_ROWS = 256  # rows per device
+MESH_WEAK_COLS = 1024
+MESH_PARITY_TURNS = 64
+
+
+def bench_mesh(ways=MESH_WAYS, turns: int = MESH_TURNS) -> int:
+    """Multi-device scaling legs (`--mesh`): for each mesh width, a
+    strong-scaling run (fixed 1024² board) and a weak-scaling run
+    (256 rows/device × 1024), each parity-gated against the 1-way run
+    of the SAME board at 64 turns.
+
+    Gated metrics, both higher-is-better (tools/perf_compare.py knows
+    the *_pct suffixes):
+
+    * scaling_efficiency_pct — strong: 100·t1/(w·tw) (perfect speedup
+      = 100); weak: 100·t1w/tw (constant per-device time = 100).
+    * halo_overlap_pct — 100·(1 − max(0, tw − t_local)/tw) where
+      t_local is a 1-way run on a shard-sized board: how much of the
+      communication + seam cost the dispatch hid behind local compute
+      (100 = the sharded run costs no more than its local share).
+
+    Every timed wall also feeds the gol_halo_* telemetry (the run
+    wrappers count the analytic traffic; the measured walls price it
+    via halostats.observe_wall) and gol_shard_imbalance_ratio is
+    sampled from the timed dispatch itself.
+
+    CAVEAT on CPU hosts: forced host-platform devices share the same
+    cores, so strong-scaling efficiency is bounded by the host's real
+    parallelism, not the algorithm — BASELINE floors for these legs
+    are deliberately loose (see BASELINE.json sources)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.obs import devstats, halostats
+    from gol_tpu.ops.bitpack import pack
+    from gol_tpu.parallel.halo import (
+        halo_traffic,
+        shard_board,
+        sharded_packed_run_turns,
+    )
+    from gol_tpu.parallel.mesh import make_mesh, mesh_geometry
+    from gol_tpu.utils.sync import wait
+
+    ndev = len(jax.devices())
+    usable = tuple(w for w in ways if w <= ndev)
+    skipped = tuple(w for w in ways if w > ndev)
+    if skipped:
+        print(f"BENCH NOTE (mesh): skipping ways {skipped}: only "
+              f"{ndev} device(s)", file=sys.stderr)
+    if not usable:
+        print("BENCH LEG SKIPPED (mesh): needs >= 2 devices",
+              file=sys.stderr)
+        return 0
+
+    # Stamp the widest mesh's geometry so /healthz and the run-report
+    # carry it when the bench runs under --self-report or mesh-smoke.
+    devstats.note_mesh(mesh_geometry(make_mesh(max(usable))))
+
+    def packed_board(h: int, w: int, seed: int) -> np.ndarray:
+        r = np.random.default_rng(seed)
+        cells01 = (r.random((h, w)) < 0.25).astype(np.uint8)
+        return np.asarray(pack(cells01))
+
+    wall_cache: dict = {}
+
+    def timed_run(key: str, words: np.ndarray, w: int, t: int):
+        """Wall of one t-turn dispatch on a w-way mesh (compile-warmed),
+        with the imbalance gauge sampled from the timed dispatch. The
+        per-shard readiness polls run host-side while the devices
+        compute, so they don't perturb the wall they observe."""
+        ck = (key, w, t)
+        if ck in wall_cache:
+            return wall_cache[ck]
+        mesh = make_mesh(w)
+        cells = shard_board(jnp.asarray(words), mesh)
+        wait(sharded_packed_run_turns(cells, t, mesh))  # compile
+        t0 = time.perf_counter()
+        out = sharded_packed_run_turns(cells, t, mesh)
+        imb = halostats.measure_shard_imbalance(out)
+        wait(out)
+        elapsed = time.perf_counter() - t0
+        traffic = (halo_traffic("packed", tuple(cells.shape), mesh, t)
+                   if w > 1 else {})
+        halostats.observe_wall(elapsed, traffic)
+        wall_cache[ck] = (elapsed, mesh, imb, traffic)
+        return wall_cache[ck]
+
+    out64_cache: dict = {}
+
+    def run64(key: str, words: np.ndarray, w: int) -> np.ndarray:
+        ck = (key, w)
+        if ck not in out64_cache:
+            mesh = make_mesh(w)
+            cells = shard_board(jnp.asarray(words), mesh)
+            out64_cache[ck] = np.asarray(
+                sharded_packed_run_turns(cells, MESH_PARITY_TURNS, mesh))
+        return out64_cache[ck]
+
+    def leg(mode: str, board_desc: str, w: int, words: np.ndarray,
+            base_wall: float, t_local: float) -> int:
+        ok = bool(np.array_equal(run64(f"{mode}-{words.shape}", words, 1),
+                                 run64(f"{mode}-{words.shape}", words, w)))
+        if not ok:
+            print(f"PARITY FAIL (mesh {mode} {w}-way): {MESH_PARITY_TURNS}"
+                  f"-turn board mismatch vs 1-way", file=sys.stderr)
+        tw, mesh, imb, traffic = timed_run(f"{mode}-{words.shape}",
+                                           words, w, turns)
+        if mode == "strong":
+            eff = 100.0 * base_wall / (w * tw)
+        else:
+            eff = 100.0 * base_wall / tw
+        overlap = 100.0 * (1.0 - max(0.0, tw - t_local) / tw)
+        overlap = min(100.0, max(0.0, overlap))
+        detail = {
+            "mode": mode, "ways": w, "turns": turns,
+            "board": [int(words.shape[0]), 32 * int(words.shape[1])],
+            "elapsed_s": round(tw, 4),
+            "baseline_1way_s": round(base_wall, 4),
+            "local_shard_s": round(t_local, 4),
+            "mesh": mesh_geometry(mesh),
+            "halo_traffic": {a: {"rounds": int(r), "bytes": int(b)}
+                             for a, (r, b) in traffic.items()},
+            "shard_imbalance_ratio": (round(imb, 3)
+                                      if imb is not None else None),
+            "alive_parity": ok,
+            "parity_check": f"{MESH_PARITY_TURNS}-turn full-board "
+                            f"equality vs 1-way packed run",
+        }
+        _emit(f"scaling_efficiency_pct ({mode}, {w}-way, {board_desc})",
+              round(eff, 1), "%", None, detail)
+        _emit(f"halo_overlap_pct ({mode}, {w}-way, {board_desc})",
+              round(overlap, 1), "%", None, detail)
+        return 0 if ok else 1
+
+    rc = 0
+    # Strong scaling: fixed 1024² board, 1-way baseline shared by all
+    # widths; t_local re-runs each width's shard shape on ONE device.
+    n = MESH_STRONG_N
+    strong = packed_board(n, n, seed=1)
+    t1, _, _, _ = timed_run(f"strong-{strong.shape}", strong, 1, turns)
+    for w in usable:
+        local = packed_board(n // w, n, seed=200 + w)
+        t_loc, _, _, _ = timed_run(f"local-{local.shape}", local, 1, turns)
+        rc |= leg("strong", f"{n}x{n}", w, strong, t1, t_loc)
+    # Weak scaling: 256 rows/device, so the 1-way wall on one shard's
+    # board is both the efficiency baseline and t_local.
+    t1w, _, _, _ = timed_run(
+        "weak-base",
+        packed_board(MESH_WEAK_ROWS, MESH_WEAK_COLS, seed=101), 1, turns)
+    for w in usable:
+        words = packed_board(MESH_WEAK_ROWS * w, MESH_WEAK_COLS,
+                             seed=100 + w)
+        rc |= leg("weak", f"{MESH_WEAK_ROWS}x{MESH_WEAK_COLS}/dev", w,
+                  words, t1w, t1w)
+    return rc
 
 
 def bench_generations(n: int, turns: int,
@@ -1263,6 +1439,18 @@ def main() -> int:
                     metavar="N",
                     help="with --load: cycles per client (default "
                          f"{LOAD_CYCLES})")
+    ap.add_argument("--mesh", action="store_true",
+                    help="run the multi-device scaling legs only: "
+                         "strong (fixed 1024²) and weak (256 rows/dev) "
+                         "runs per mesh width, parity-gated, emitting "
+                         "the gated scaling_efficiency_pct / "
+                         "halo_overlap_pct lines; forces 8 host "
+                         "devices unless XLA_FLAGS already pins a "
+                         "count")
+    ap.add_argument("--mesh-ways", default="", metavar="W[,W...]",
+                    help="with --mesh: comma-separated mesh widths "
+                         "(default 2,4,8; widths beyond the device "
+                         "count are skipped with a note)")
     ap.add_argument("--ksweep", action="store_true",
                     help="two-point K-sweep for --size: marginal "
                          "per-turn cost + asymptotic cups + roofline")
@@ -1271,6 +1459,19 @@ def main() -> int:
                          "gol-run-report/1 bench_leg record to PATH "
                          "(same schema family as --run-report)")
     args = ap.parse_args()
+    if args.mesh:
+        # Multi-device legs need devices. On hosts where XLA has not
+        # been configured the CPU platform exposes ONE device; force 8
+        # virtual host devices — but only when the user hasn't pinned a
+        # count, and strictly before any jax backend initialisation
+        # (the --self-report ident below queries jax.devices()).
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     if args.self_report:
         from gol_tpu.obs.timeline import RunReporter
 
@@ -1343,6 +1544,28 @@ def main() -> int:
 
 
 def _dispatch(args, ap) -> int:
+    if args.mesh:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.fleet or args.load or args.size is not None:
+            ap.error("--mesh is its own config; combine only with "
+                     "--mesh-ways/--turns")
+        if args.mesh_ways:
+            try:
+                ways = tuple(int(x) for x in
+                             args.mesh_ways.split(",") if x.strip())
+            except ValueError:
+                ap.error("--mesh-ways wants comma-separated integers")
+            if not ways or min(ways) < 2:
+                ap.error("--mesh-ways wants mesh widths >= 2")
+        else:
+            ways = MESH_WAYS
+        return bench_mesh(
+            ways=ways,
+            turns=args.turns if args.turns is not None else MESH_TURNS)
+    if args.mesh_ways:
+        ap.error("--mesh-ways applies to the --mesh leg only")
+
     if args.fleet:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead:

@@ -280,6 +280,9 @@ class CheckpointWriter:
             device = _device_ident()
             if device is not None:
                 manifest["device"] = device
+            mesh_geom = _mesh_ident()
+            if mesh_geom:
+                manifest["mesh"] = mesh_geom
             if snap.extra:
                 manifest["sparse"] = {k: int(v)
                                       for k, v in snap.extra.items()}
@@ -301,6 +304,19 @@ def _writer_ident() -> dict:
         pass
     ident["numpy"] = np.__version__
     return ident
+
+
+def _mesh_ident() -> Optional[dict]:
+    """Mesh geometry of the run being checkpointed (the engine stamps
+    it at submit via devstats.note_mesh), or None before any sharded
+    submit. read_manifest tolerates extra keys, so old readers skip it."""
+    try:
+        from gol_tpu.obs import devstats
+
+        mesh = devstats.mesh_fields()
+    except Exception:  # telemetry must never sink a checkpoint
+        return None
+    return mesh or None
 
 
 def _device_ident() -> Optional[dict]:

@@ -52,6 +52,7 @@ from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import devstats as obs_devstats
 from gol_tpu.obs import flight as obs_flight
+from gol_tpu.obs import halostats as obs_halostats
 from gol_tpu.obs import prof as obs_prof
 from gol_tpu.obs import timeline as obs_timeline
 from gol_tpu.obs import trace as obs_trace
@@ -59,7 +60,7 @@ from gol_tpu.ops.bitpack import pack, packed_alive_count, unpack
 from gol_tpu.ops.stencil import alive_count_exact, from_pixels, to_pixels
 from gol_tpu.params import Params
 from gol_tpu.parallel.halo import select_representation, shard_board
-from gol_tpu.parallel.mesh import make_mesh
+from gol_tpu.parallel.mesh import make_mesh, mesh_geometry
 from gol_tpu.utils.envcfg import env_float, env_int
 from gol_tpu.utils.sync import wait
 
@@ -574,6 +575,9 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
         # later runs of the same configuration start there, skipping the
         # synchronous ramp's round trips.
         self._chunk_hints: dict = {}
+        # Mesh geometry of the most recently submitted run
+        # (parallel.mesh.mesh_geometry dict), for stats()/Stats.
+        self._mesh_geom: Optional[dict] = None
 
     def _publish_locked(self, alive: int, turn: int,
                         reset_floor: bool = False) -> None:
@@ -777,11 +781,18 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
         reporter = obs_timeline.from_env()
         run_t0 = time.monotonic()
         board_cells = height * width
+        # Mesh geometry stamp: the gol_mesh_* gauges, /healthz `mesh`,
+        # checkpoint manifests, and Stats all read this one dict.
+        mesh_geom = mesh_geometry(mesh)
+        self._mesh_geom = mesh_geom
+        obs_devstats.note_mesh(mesh_geom)
         if reporter is not None:
             reporter.emit(
                 "run_start", w=width, h=height,
                 model=self._rule.rulestring, repr=repr_,
-                devices=int(mesh.size), turns_requested=params.turns,
+                devices=int(mesh.size), shards=mesh_geom["shards"],
+                mesh_shape=mesh_geom["shape"], mesh_axes=mesh_geom["axes"],
+                turns_requested=params.turns,
                 start_turn=start_turn)
         obs.ENGINE_CHUNK_SIZE.set(chunk)
         # GOL_PROFILE_DIR: one-shot env contract (set by --profile-dir)
@@ -854,6 +865,20 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
         pend_turns = 0
         pend_elapsed: list = []
         pend_flags: list = []
+        # Per-chunk halo accounting for sharded runs: the dispatch
+        # wrappers in parallel/halo.py skip tracers (under the jitted
+        # token wrapper they execute once per compilation), so the loop
+        # buffers (turns, wall) per popped chunk here and drains them at
+        # flush cadence through the analytic traffic model. The
+        # wrap-extension path is excluded — its seam collectives are
+        # GSPMD-inserted, not modelled by halo_traffic.
+        pend_halo: list = []
+        halo_traffic_fn = None
+        if pad_rows == 0 and int(mesh.size) > 1:
+            from gol_tpu.parallel.halo import halo_traffic
+
+            halo_traffic_fn = functools.partial(
+                halo_traffic, repr_, tuple(cells.shape), mesh)
         last_cups = 0.0
         last_rate = 0.0
         last_done_turn = start_turn
@@ -882,6 +907,9 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             if pend_flags:
                 obs.ENGINE_FLAG_SERVICE_SECONDS.observe_batch(pend_flags)
                 pend_flags.clear()
+            if pend_halo:
+                obs_halostats.flush_chunk_walls(pend_halo, halo_traffic_fn)
+                pend_halo.clear()
             obs.ENGINE_TURN.set(last_done_turn)
             obs.ENGINE_CHUNK_SIZE.set(chunk)
             if last_cups > 0:
@@ -994,6 +1022,8 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             pend_chunks += 1
             pend_turns += done_k
             pend_elapsed.append(elapsed)
+            if halo_traffic_fn is not None:
+                pend_halo.append((done_k, elapsed))
             if cups > 0:
                 last_cups = cups
             if rate > 0:
@@ -1475,6 +1505,9 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                 "chunk_overhead_us": round(self._chunk_overhead_us, 2),
                 "rule": self._rule.rulestring,
                 "devices": len(self._devices),
+                # Geometry of the last submitted run's mesh (None before
+                # the first run): axis sizes, shard count, grid shape.
+                "mesh": self._mesh_geom,
             }
 
     # -------------------------------------------------------- checkpointing

@@ -367,6 +367,104 @@ DEV_DEVICES = REGISTRY.gauge(
     "gol_dev_devices",
     "Number of addressable devices visible to the process.")
 
+# ---------------------------------------------------- mesh & halo telemetry
+
+# Geometry of the most recently submitted run's device mesh (from
+# parallel.mesh.mesh_geometry via devstats.note_mesh) plus the halo/
+# collective traffic of sharded dispatches (obs/halostats.py). Axis
+# labels are clamped to the declared mesh axes; device-count labels are
+# bounded by the local device count, same as gol_dev_live_bytes.
+MESH_AXES = ("rows", "cols")
+
+MESH_DEVICES = REGISTRY.gauge(
+    "gol_mesh_devices",
+    "Devices in the most recently submitted run's mesh (1 for "
+    "single-device runs).")
+MESH_SHARDS = REGISTRY.gauge(
+    "gol_mesh_shards",
+    "Board shards in the most recently submitted run's mesh (equal to "
+    "gol_mesh_devices for the 1-D and 2-D meshes the engine builds).")
+MESH_AXIS_SIZE = REGISTRY.gauge(
+    "gol_mesh_axis_size",
+    "Mesh extent along each named axis for the most recently submitted "
+    "run; 0 for an axis the mesh does not have (a 1-D rows mesh leaves "
+    "cols at 0).",
+    label_names=("axis",))
+
+HALO_EXCHANGES = REGISTRY.counter(
+    "gol_halo_exchanges_total",
+    "Halo exchange rounds dispatched, by mesh axis. One round is one "
+    "paired ppermute send (both ring directions issued together) across "
+    "the whole mesh — the latency-exposure unit of the deep-halo macro "
+    "schedule. Analytic from the dispatch geometry, not a link probe.",
+    label_names=("axis",))
+HALO_BYTES = REGISTRY.counter(
+    "gol_halo_bytes_total",
+    "Bytes moved by halo exchange rounds, by mesh axis, summed over "
+    "every shard's sends (whole-mesh traffic). Analytic from the "
+    "dispatch geometry: rows x row-bytes x shards per round.",
+    label_names=("axis",))
+HALO_EXCHANGE_SECONDS = REGISTRY.histogram(
+    "gol_halo_exchange_seconds",
+    "Wall seconds per halo exchange round: dispatch wall divided by the "
+    "round count of that dispatch. Exact for synchronous callers "
+    "(bench legs); pipeline-amortized for engine chunks. Prices the "
+    "round including any local compute it failed to overlap.",
+    buckets=(1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3,
+             1e-2, 5e-2, 1e-1, 5e-1, 1.0))
+SHARD_IMBALANCE = REGISTRY.gauge(
+    "gol_shard_imbalance_ratio",
+    "max/mean of per-shard cumulative readiness waits observed host-"
+    "side after a sharded dispatch (obs/halostats."
+    "measure_shard_imbalance): a completion-spread signal (1.0 = "
+    "balanced), not a per-device timer.")
+
+for _a in MESH_AXES:
+    MESH_AXIS_SIZE.labels(axis=_a)
+    HALO_EXCHANGES.labels(axis=_a)
+    HALO_BYTES.labels(axis=_a)
+
+
+def mesh_axis_label(axis: str) -> str:
+    """Clamp arbitrary mesh-axis names to the declared set."""
+    return axis if axis in MESH_AXES else "other"
+
+
+# Per-device kind census: heterogeneous device lists (a CPU host plus
+# accelerators, or mixed TPU generations) get one gauge child per kind,
+# clamped to a small declared budget so a pathological backend cannot
+# mint unbounded label values.
+DEV_KIND_MAX = 8
+
+DEV_KIND_DEVICES = REGISTRY.gauge(
+    "gol_dev_kind_devices",
+    "Addressable devices by device_kind string, from the last device "
+    "poll. At most DEV_KIND_MAX distinct kinds are labelled; overflow "
+    "kinds aggregate under 'other'.",
+    label_names=("kind",))
+
+_seen_kinds: set = set()
+
+
+def dev_kind_label(kind: str) -> str:
+    """Clamp device-kind label values to a bounded set (first
+    DEV_KIND_MAX distinct kinds seen, then 'other')."""
+    if kind in _seen_kinds:
+        return kind
+    if len(_seen_kinds) < DEV_KIND_MAX:
+        _seen_kinds.add(kind)
+        return kind
+    return "other"
+
+
+DEV_MEM_STATS_SUPPORTED = REGISTRY.gauge(
+    "gol_dev_mem_stats_supported",
+    "1 if device.memory_stats() returns data for this device, else 0 — "
+    "one child per addressable device, so heterogeneous lists (some "
+    "devices with stats, some without) are visible per device rather "
+    "than collapsed into the scalar gol_dev_mem_supported.",
+    label_names=("device",))
+
 # ------------------------------------------------------------ compilation
 
 COMPILE_TOTAL = REGISTRY.counter(

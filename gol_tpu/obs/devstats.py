@@ -45,6 +45,11 @@ _last_poll: dict = {
     "per_device": None,
 }
 
+# Mesh geometry of the most recently submitted run (engine calls
+# note_mesh at submit; bench legs may too). Same cached-for-healthz
+# contract as _last_poll: the HTTP layer reads this dict, never jax.
+_mesh: dict = {}
+
 # memory_stats() key aliases across backends.  TPU/GPU PJRT clients use
 # bytes_in_use/peak_bytes_in_use; bytes_limit is best-effort.
 _LIVE_KEYS = ("bytes_in_use", "bytes_used", "allocated_bytes")
@@ -85,15 +90,29 @@ def memory_snapshot(device: Any) -> Optional[dict]:
     }
 
 
+def _kind_summary(kinds) -> Optional[str]:
+    """Aggregate device-kind strings across a device list: the plain
+    kind when homogeneous ("cpu"), '+'-joined sorted distinct kinds
+    when mixed ("TPU v4+cpu"), None for an empty list — device 0 does
+    not get to speak for a heterogeneous fleet."""
+    distinct = sorted({str(k) for k in kinds if k})
+    if not distinct:
+        return None
+    if len(distinct) == 1:
+        return distinct[0]
+    return "+".join(distinct)
+
+
 def device_kind() -> Optional[str]:
-    """Kind string of device 0 ("cpu", "TPU v4", ...), cached."""
+    """Aggregated kind string over ALL devices ("cpu", "TPU v4",
+    "TPU v4+cpu" when mixed), cached."""
     with _lock:
         if _last_poll["device_kind"] is not None:
             return _last_poll["device_kind"]
     try:
         import jax
 
-        kind = jax.devices()[0].device_kind
+        kind = _kind_summary(d.device_kind for d in jax.devices())
     except Exception:
         return None
     with _lock:
@@ -119,14 +138,27 @@ def poll_device_memory() -> dict:
         return summary
 
     per_device = {}
+    kind_counts: dict = {}
     live_total = peak_total = 0
     supported = False
+    supported_devices = 0
     for d in devices:
+        dev_id = str(d.id)
+        try:
+            kind = str(d.device_kind)
+        except Exception:
+            kind = "unknown"
+        kind_counts[kind] = kind_counts.get(kind, 0) + 1
         snap = memory_snapshot(d)
+        # Every device gets its supported child, so a heterogeneous
+        # list (some devices with stats, some without) is visible per
+        # device instead of collapsed into the scalar any-device flag.
+        _cat.DEV_MEM_STATS_SUPPORTED.labels(device=dev_id).set(
+            0.0 if snap is None else 1.0)
         if snap is None:
             continue
         supported = True
-        dev_id = str(d.id)
+        supported_devices += 1
         per_device[dev_id] = {k: snap[k] for k in
                               ("live_bytes", "peak_bytes", "limit_bytes")}
         if snap["live_bytes"] is not None:
@@ -142,11 +174,16 @@ def poll_device_memory() -> dict:
                 snap["limit_bytes"])
     _cat.DEV_MEM_SUPPORTED.set(1.0 if supported else 0.0)
     _cat.DEV_DEVICES.set(float(len(devices)))
+    for kind, count in kind_counts.items():
+        _cat.DEV_KIND_DEVICES.labels(
+            kind=_cat.dev_kind_label(kind)).set(float(count))
 
     summary = {
-        "device_kind": devices[0].device_kind if devices else None,
+        "device_kind": _kind_summary(kind_counts),
         "devices": len(devices),
         "supported": supported,
+        "supported_devices": supported_devices,
+        "device_kinds": kind_counts,
         "live_bytes": live_total if supported else None,
         "peak_bytes": peak_total if supported else None,
         "per_device": per_device,
@@ -154,6 +191,31 @@ def poll_device_memory() -> dict:
     with _lock:
         _last_poll.update(summary)
     return summary
+
+
+def note_mesh(geom: Optional[dict]) -> None:
+    """Record the most recently submitted run's mesh geometry (a
+    `parallel.mesh.mesh_geometry` dict) and publish the gol_mesh_*
+    gauges. Called by the engine at run submit; empty/None input is a
+    no-op so a failed geometry probe never clears the last good one."""
+    if not geom:
+        return
+    with _lock:
+        _mesh.clear()
+        _mesh.update(geom)
+    _cat.MESH_DEVICES.set(float(geom.get("devices", 0)))
+    _cat.MESH_SHARDS.set(float(geom.get("shards", 0)))
+    axes = geom.get("axes") or {}
+    for axis in _cat.MESH_AXES:
+        _cat.MESH_AXIS_SIZE.labels(axis=axis).set(
+            float(axes.get(axis, 0)))
+
+
+def mesh_fields() -> dict:
+    """Cached mesh geometry of the last submitted run ({} before any
+    note_mesh) — never imports jax."""
+    with _lock:
+        return dict(_mesh)
 
 
 def healthz_fields() -> dict:
@@ -164,6 +226,7 @@ def healthz_fields() -> dict:
         "device_kind": cached["device_kind"],
         "live_bytes": cached["live_bytes"],
         "compile_count": int(_cat.COMPILE_TOTAL.value),
+        "mesh": mesh_fields(),
     }
 
 

@@ -1,0 +1,137 @@
+"""Halo/collective telemetry aggregation (the gol_halo_* feeds).
+
+Two producers feed this module, matching how sharded dispatches happen:
+
+* EAGER callers (bench legs, direct kernel users, tests) call the
+  `parallel/halo.py` / `parallel/mesh2d.py` run wrappers with concrete
+  arrays; those wrappers note each dispatch here as it happens (and
+  skip tracers, see below).
+* The ENGINE composes the sharded run inside its jitted token wrapper
+  (`engine._tokened_run`), so the wrappers only execute at trace time
+  there — once per compilation, with tracers.  The engine therefore
+  buffers (turns, wall_s) pairs per popped chunk in its hot-loop locals
+  and drains them through `flush_chunk_walls` at the same batched
+  metrics flush that owns every other engine gauge (the PR-6 cadence):
+  zero registry traffic per chunk, everything at flush boundaries.
+
+Semantics, stated honestly:
+
+* gol_halo_exchanges_total / gol_halo_bytes_total are EXACT analytic
+  counts derived from the static dispatch geometry (shard shape, macro
+  depth T, turn count — `parallel.halo.halo_traffic`).  They count
+  ppermute exchange rounds and the bytes those rounds move across the
+  whole mesh; they are not a link probe.
+* gol_halo_exchange_seconds is the dispatch wall divided by the number
+  of exchange rounds in that dispatch: exact per-round latency for
+  synchronous callers, pipeline-amortized for engine chunks.  It prices
+  a round including whatever local compute it failed to overlap.
+* gol_shard_imbalance_ratio is max/mean of per-shard cumulative
+  readiness waits observed host-side in shard order — a completion-
+  spread signal (1.0 = balanced), not a per-device timer.
+
+Stdlib-only: jax never enters this module (the obs-package contract);
+callers hand in plain ints/floats, and `measure_shard_imbalance` only
+duck-types `.addressable_shards` on whatever array it is given.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+from gol_tpu.obs import catalog as _cat
+
+# Traffic dicts map mesh-axis name -> (exchange_rounds, total_bytes).
+Traffic = Dict[str, Tuple[int, int]]
+
+
+def note_traffic(traffic: Traffic) -> None:
+    """Fold one dispatch's analytic traffic into the counters."""
+    for axis, (rounds, nbytes) in traffic.items():
+        lab = _cat.mesh_axis_label(axis)
+        if rounds:
+            _cat.HALO_EXCHANGES.labels(axis=lab).inc(int(rounds))
+        if nbytes:
+            _cat.HALO_BYTES.labels(axis=lab).inc(int(nbytes))
+
+
+def total_rounds(traffic: Traffic) -> int:
+    return sum(int(r) for r, _ in traffic.values())
+
+
+def total_bytes(traffic: Traffic) -> int:
+    return sum(int(b) for _, b in traffic.values())
+
+
+def observe_dispatch(elapsed_s: float, traffic: Traffic) -> None:
+    """Price one SYNCHRONOUS dispatch (caller timed dispatch→ready):
+    counters plus the amortized per-exchange-round latency."""
+    note_traffic(traffic)
+    observe_wall(elapsed_s, traffic)
+
+
+def observe_wall(elapsed_s: float, traffic: Traffic) -> None:
+    """Histogram-only variant for callers whose dispatch already went
+    through a counting run wrapper (`parallel.halo.dispatch_obs` notes
+    eager traffic itself) — feeding `observe_dispatch` there would
+    double the counters."""
+    rounds = total_rounds(traffic)
+    if rounds and elapsed_s > 0:
+        _cat.HALO_EXCHANGE_SECONDS.observe(elapsed_s / rounds)
+
+
+def flush_chunk_walls(
+    walls: Iterable[Tuple[int, float]],
+    traffic_for_k: Callable[[int], Traffic],
+) -> None:
+    """Engine-side drain: `walls` holds (turns, wall_s) per popped
+    chunk, `traffic_for_k(turns)` that chunk's analytic traffic.  One
+    histogram lock via observe_batch; counters folded once per axis —
+    the whole call is a handful of dict ops per flush window."""
+    per_axis: Dict[str, Tuple[int, int]] = {}
+    samples = []
+    for k, wall in walls:
+        traffic = traffic_for_k(k)
+        if not traffic:
+            continue
+        rounds = 0
+        for axis, (r, b) in traffic.items():
+            er, eb = per_axis.get(axis, (0, 0))
+            per_axis[axis] = (er + int(r), eb + int(b))
+            rounds += int(r)
+        if rounds and wall > 0:
+            samples.append(wall / rounds)
+    for axis, (rounds, nbytes) in per_axis.items():
+        lab = _cat.mesh_axis_label(axis)
+        if rounds:
+            _cat.HALO_EXCHANGES.labels(axis=lab).inc(rounds)
+        if nbytes:
+            _cat.HALO_BYTES.labels(axis=lab).inc(nbytes)
+    if samples:
+        _cat.HALO_EXCHANGE_SECONDS.observe_batch(samples)
+
+
+def measure_shard_imbalance(out) -> Optional[float]:
+    """Publish gol_shard_imbalance_ratio from a sharded array's
+    per-shard readiness spread; returns the ratio, or None when `out`
+    has fewer than two addressable shards (nothing to compare).
+
+    Blocks on each shard IN SHARD ORDER and records the cumulative wait
+    at each step, so the ratio is order-biased toward late high-index
+    shards — good enough to flag one straggling device, not a timer.
+    Callers should pass a freshly dispatched (not yet awaited) array."""
+    shards = getattr(out, "addressable_shards", None)
+    if shards is None or len(shards) < 2:
+        return None
+    waits = []
+    t0 = time.perf_counter()
+    for s in shards:
+        try:
+            s.data.block_until_ready()
+        except Exception:  # telemetry must never sink the caller
+            return None
+        waits.append(max(time.perf_counter() - t0, 0.0))
+    mean = sum(waits) / len(waits)
+    ratio = max(waits) / mean if mean > 1e-9 else 1.0
+    _cat.SHARD_IMBALANCE.set(ratio)
+    return ratio

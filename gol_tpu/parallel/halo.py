@@ -18,6 +18,7 @@ stencil halo.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional
 
@@ -99,7 +100,9 @@ def sharded_run_turns(
     rule: LifeLikeRule = CONWAY,
 ) -> jax.Array:
     """Advance a row-sharded board `num_turns` turns on the mesh."""
-    return _make_compiled_run(mesh, rule, _local_step)(cells, num_turns)
+    with dispatch_obs("u8", cells, num_turns, mesh):
+        return _make_compiled_run(mesh, rule, _local_step)(
+            cells, num_turns)
 
 
 # ----------------------------------------------------------------- packed
@@ -167,6 +170,103 @@ def _deep_halo_T(num_turns: int, shard_rows: int) -> int:
     ):
         t *= 2
     return t
+
+
+@functools.lru_cache(maxsize=1024)
+def halo_traffic(repr_, shape, mesh, num_turns) -> dict:
+    """Analytic ppermute traffic of ONE dispatch of `num_turns` turns:
+    {axis: (exchange_rounds, total_bytes)}. An exchange round is one
+    paired send (`exchange_halos` issues its two ppermutes together, so
+    the pair is the latency-exposure unit); bytes sum every shard's
+    sends, i.e. whole-mesh traffic per round. Mirrors the dispatch
+    logic below exactly (deep-halo T selection, 2-D macro decomposition)
+    so the counts stay honest without touching the compiled program.
+
+    Returns {} when the dispatch moves no explicit halo traffic: a
+    single shard along every axis, zero turns, or the wrap-extension
+    path (`extended_run_turns`), whose cross-shard seam collectives are
+    GSPMD-inserted and not modelled here — engine callers gate on
+    pad_rows == 0 for that reason.
+
+    `repr_` is 'packed' | 'u8' | 'gen8' | 'gen3'; 2-D meshes (a 'cols'
+    axis present) are packed-only and routed by the mesh itself.
+    `shape` must be a plain tuple (this is an lru_cache key)."""
+    if num_turns <= 0:
+        return {}
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_rows = int(axes.get(ROWS_AXIS, 1))
+    if "cols" in axes:  # 2-D mesh (mesh2d.COLS_AXIS; literal avoids a
+        n_cols = int(axes["cols"])  # circular import — mesh2d imports us)
+        from gol_tpu.parallel.mesh2d import MAX_T_2D
+
+        h, wp = shape
+        shard_rows, shard_cols = h // n_rows, wp // n_cols
+        T = min(MAX_T_2D, shard_rows)
+        full, rem = divmod(num_turns, T)
+        depths = [T] * full + ([rem] if rem else [])
+        out = {}
+        if n_rows > 1:
+            out[ROWS_AXIS] = (
+                len(depths),
+                sum(2 * t * shard_cols * 4 * n_rows * n_cols
+                    for t in depths))
+        if n_cols > 1:
+            # One word column per macro, cut from the row-extended
+            # (shard_rows + 2t) window so the corners ride along.
+            out["cols"] = (
+                len(depths),
+                sum(2 * (shard_rows + 2 * t) * 4 * n_rows * n_cols
+                    for t in depths))
+        return out
+    if n_rows <= 1:
+        return {}
+    if repr_ == "gen3":
+        rows_len = shape[1]  # stacked (2, H, Wp) planes
+        row_bytes = shape[-1] * 4  # alive plane only is exchanged
+    elif repr_ == "packed":
+        rows_len = shape[0]
+        row_bytes = shape[-1] * 4
+    else:  # u8 / gen8 state boards
+        rows_len = shape[0]
+        row_bytes = shape[-1]
+    if repr_ == "packed":
+        shard_rows = rows_len // n_rows
+        T = _deep_halo_T(num_turns, shard_rows)
+        if T > 1:
+            rounds = num_turns // T
+            return {ROWS_AXIS: (
+                rounds, rounds * 2 * T * row_bytes * n_rows)}
+    rounds = num_turns
+    return {ROWS_AXIS: (rounds, rounds * 2 * row_bytes * n_rows)}
+
+
+def dispatch_obs(repr_, cells, num_turns, mesh):
+    """Host-side observability for one EAGER sharded dispatch: fold the
+    analytic traffic into the gol_halo_* counters and, when span
+    tracing is armed, return a 'halo.dispatch' span context covering
+    the (asynchronous) dispatch. Tracer inputs are skipped entirely —
+    under the engine's jit-composed token wrapper these wrappers run
+    once per compilation, and the engine does its own per-chunk
+    accounting through obs/halostats.flush_chunk_walls. Never raises:
+    telemetry must not sink a dispatch."""
+    if isinstance(cells, jax.core.Tracer):
+        return contextlib.nullcontext()
+    try:
+        traffic = halo_traffic(repr_, tuple(cells.shape), mesh, num_turns)
+        if not traffic:
+            return contextlib.nullcontext()
+        from gol_tpu.obs import halostats, trace
+
+        halostats.note_traffic(traffic)
+        if not trace.hot_spans_enabled():
+            return contextlib.nullcontext()
+        return trace.TRACER.span("halo.dispatch", attrs={
+            "repr": repr_, "turns": num_turns,
+            "shards": int(mesh.size),
+            "exchange_rounds": halostats.total_rounds(traffic),
+            "halo_bytes": halostats.total_bytes(traffic)})
+    except Exception:
+        return contextlib.nullcontext()
 
 
 def _packed_deep_macro(
@@ -325,15 +425,16 @@ def sharded_packed_run_turns(
         # Platform from the (static) mesh, not the array: jit-composable.
         return _single_device_packed_run(
             packed, num_turns, rule, mesh.devices.flat[0].platform)
-    shard_rows = packed.shape[-2] // n_shards
-    T = _deep_halo_T(num_turns, shard_rows)
-    if T > 1:
-        window_shape = (shard_rows + 2 * T, packed.shape[-1])
-        inner = inner_kind(mesh, window_shape, T)
-        run = _make_compiled_deep_run(mesh, rule, T, inner)
-        return run(packed, num_turns // T)
-    return _make_compiled_run(mesh, rule, _packed_local_step)(
-        packed, num_turns)
+    with dispatch_obs("packed", packed, num_turns, mesh):
+        shard_rows = packed.shape[-2] // n_shards
+        T = _deep_halo_T(num_turns, shard_rows)
+        if T > 1:
+            window_shape = (shard_rows + 2 * T, packed.shape[-1])
+            inner = inner_kind(mesh, window_shape, T)
+            run = _make_compiled_deep_run(mesh, rule, T, inner)
+            return run(packed, num_turns // T)
+        return _make_compiled_run(mesh, rule, _packed_local_step)(
+            packed, num_turns)
 
 
 # ----------------------------------------------- exact-N odd heights
@@ -511,7 +612,9 @@ def sharded_generations_run_turns(
     state: jax.Array, num_turns: int, mesh: Mesh, rule
 ) -> jax.Array:
     """Advance a row-sharded uint8 Generations state board."""
-    return _make_compiled_run(mesh, rule, _gen_local_step)(state, num_turns)
+    with dispatch_obs("gen8", state, num_turns, mesh):
+        return _make_compiled_run(mesh, rule, _gen_local_step)(
+            state, num_turns)
 
 
 def gen3_planes_sharding(mesh: Mesh):
@@ -596,7 +699,8 @@ def sharded_gen3_run_turns(
     if mesh.shape[ROWS_AXIS] == 1:
         return _gen3_single_run(
             rule, mesh.devices.flat[0].platform)(stacked, num_turns)
-    return _make_compiled_gen3_run(mesh, rule)(stacked, num_turns)
+    with dispatch_obs("gen3", stacked, num_turns, mesh):
+        return _make_compiled_gen3_run(mesh, rule)(stacked, num_turns)
 
 
 def select_representation(width: int):

@@ -65,3 +65,19 @@ def make_mesh(
 def board_sharding(mesh: Mesh) -> NamedSharding:
     """Board rows split over the mesh, columns replicated."""
     return NamedSharding(mesh, P(ROWS_AXIS, None))
+
+
+def mesh_geometry(mesh: Mesh) -> dict:
+    """JSON-able geometry of a mesh: device count, shard count (equal
+    for the 1-D and 2-D meshes built here — every device holds one
+    shard), axis sizes by name, and the grid shape. The one dict every
+    obs surface stamps: /healthz `mesh`, run-report `run_start`,
+    checkpoint manifests, and bench detail records."""
+    axes = {str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+    return {
+        "devices": int(mesh.size),
+        "shards": int(mesh.size),
+        "axes": axes,
+        "shape": [int(s) for s in mesh.devices.shape],
+    }

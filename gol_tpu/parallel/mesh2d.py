@@ -29,7 +29,7 @@ from gol_tpu.parallel.shmap import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
-from gol_tpu.parallel.halo import inner_kind
+from gol_tpu.parallel.halo import dispatch_obs, inner_kind
 from gol_tpu.parallel.mesh import ROWS_AXIS
 
 COLS_AXIS = "cols"
@@ -133,12 +133,16 @@ def sharded_packed_run_turns_2d(
     inner = inner_kind(mesh, (shard_rows + 2 * T, shard_cols + 2), T)
     run = _make_compiled_run2d(mesh, rule, T, inner)
     full, rem = divmod(num_turns, T)
-    out = run(packed, full)
-    if rem:
-        # The remainder window has a DIFFERENT height and depth — re-pick
-        # the inner engine for it (a height whose banded band sizing
-        # worked at depth T may have no viable band at depth rem).
-        inner_rem = inner_kind(
-            mesh, (shard_rows + 2 * rem, shard_cols + 2), rem)
-        out = _make_compiled_run2d(mesh, rule, rem, inner_rem)(out, 1)
-    return out
+    # dispatch_obs routes 2-D traffic by the mesh's cols axis; the one
+    # span covers both the full-depth macros and the remainder macro.
+    with dispatch_obs("packed", packed, num_turns, mesh):
+        out = run(packed, full)
+        if rem:
+            # The remainder window has a DIFFERENT height and depth —
+            # re-pick the inner engine for it (a height whose banded
+            # band sizing worked at depth T may have no viable band at
+            # depth rem).
+            inner_rem = inner_kind(
+                mesh, (shard_rows + 2 * rem, shard_cols + 2), rem)
+            out = _make_compiled_run2d(mesh, rule, rem, inner_rem)(out, 1)
+        return out

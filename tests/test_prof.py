@@ -82,10 +82,14 @@ def test_memory_snapshot_reads_backend_stats():
 def test_healthz_fields_never_touch_jax():
     devstats.poll_device_memory()
     fields = devstats.healthz_fields()
-    assert set(fields) == {"device_kind", "live_bytes", "compile_count"}
+    assert set(fields) == {"device_kind", "live_bytes", "compile_count",
+                           "mesh"}
     assert fields["device_kind"] == "cpu"
     assert fields["live_bytes"] is None
     assert fields["compile_count"] == int(catalog.COMPILE_TOTAL.value)
+    # mesh geometry is a cached stamp too — a dict (possibly empty when
+    # no sharded run has happened), never a jax call from here
+    assert isinstance(fields["mesh"], dict)
 
 
 def test_healthz_doc_carries_device_fields():

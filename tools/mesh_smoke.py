@@ -1,0 +1,144 @@
+"""mesh-smoke: prove the multi-device scaling telemetry end to end.
+
+Runs one 4-way `bench.py --mesh` leg IN-PROCESS on CPU (8 forced host
+devices), then validates every surface the leg is supposed to light up:
+
+  * the emitted bench lines parse, are parity-clean, and carry true
+    mesh geometry plus the analytic halo traffic in their detail;
+  * the gol_mesh_* / gol_halo_* / gol_shard_imbalance_ratio families
+    hold non-zero samples in the registry after the run (the halo
+    histogram actually observed the measured walls);
+  * `devstats.healthz_fields()` carries the stamped `mesh` geometry —
+    the same dict `/healthz` serves;
+  * tools/perf_compare.py gates the captured lines against the
+    committed BASELINE.json floors (scaling_efficiency_pct /
+    halo_overlap_pct, higher is better).
+
+Exit 0 = pass.
+
+    make mesh-smoke     # part of the `make smoke` chain
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+from contextlib import redirect_stdout
+
+# Runnable as `python tools/mesh_smoke.py` from a bare clone: put the
+# repo root (this file's parent's parent) ahead of tools/ on sys.path.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The leg needs devices; force 8 virtual host devices strictly before
+# any jax backend initialisation (same guard as bench.py --mesh).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+MESH_SMOKE_WAYS = (4,)
+MESH_SMOKE_TURNS = 512
+
+
+def main() -> int:
+    import bench
+
+    problems = []
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.bench_mesh(ways=MESH_SMOKE_WAYS,
+                              turns=MESH_SMOKE_TURNS)
+    captured = buf.getvalue()
+    sys.stdout.write(captured)
+    if rc != 0:
+        problems.append(f"bench_mesh rc={rc} (parity gate failed?)")
+
+    # ---- bench lines ---------------------------------------------------
+    recs = []
+    for line in captured.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            recs.append(json.loads(line))
+        except ValueError:
+            problems.append(f"unparseable bench line: {line[:80]!r}")
+    names = {r.get("metric", "") for r in recs}
+    for needed in ("scaling_efficiency_pct (strong, 4-way, 1024x1024)",
+                   "halo_overlap_pct (strong, 4-way, 1024x1024)",
+                   "scaling_efficiency_pct (weak, 4-way, 256x1024/dev)",
+                   "halo_overlap_pct (weak, 4-way, 256x1024/dev)"):
+        if needed not in names:
+            problems.append(f"missing bench line {needed!r}")
+    for r in recs:
+        d = r.get("detail", {})
+        if d.get("alive_parity") is not True:
+            problems.append(f"parity not clean on {r.get('metric')!r}")
+        mesh = d.get("mesh") or {}
+        if mesh.get("devices") != 4 or mesh.get("shards") != 4 \
+                or mesh.get("axes") != {"rows": 4}:
+            problems.append(f"bad mesh geometry in detail: {mesh!r}")
+        rows = (d.get("halo_traffic") or {}).get("rows") or {}
+        if not rows.get("rounds") or not rows.get("bytes"):
+            problems.append(f"no halo traffic in detail of "
+                            f"{r.get('metric')!r}: {rows!r}")
+
+    # ---- registry families hold real samples ---------------------------
+    from gol_tpu.obs.metrics import REGISTRY
+
+    samples = {}
+    for line in REGISTRY.render_prometheus().splitlines():
+        if line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            samples[key] = float(val)
+        except ValueError:
+            pass
+    for key in ("gol_mesh_devices", "gol_mesh_shards",
+                'gol_mesh_axis_size{axis="rows"}',
+                'gol_halo_exchanges_total{axis="rows"}',
+                'gol_halo_bytes_total{axis="rows"}',
+                "gol_halo_exchange_seconds_count",
+                "gol_shard_imbalance_ratio"):
+        if samples.get(key, 0) <= 0:
+            problems.append(f"registry sample not populated: {key!r} "
+                            f"= {samples.get(key)}")
+
+    # ---- /healthz mesh stamp -------------------------------------------
+    from gol_tpu.obs import devstats
+
+    mesh_f = devstats.healthz_fields().get("mesh") or {}
+    if mesh_f.get("devices") != 4 or mesh_f.get("shards") != 4:
+        problems.append(f"healthz mesh geometry: {mesh_f!r}")
+
+    # ---- perf_compare gate round-trip ----------------------------------
+    import perf_compare
+
+    tmpdir = tempfile.mkdtemp(prefix="gol_mesh_smoke_")
+    out_path = os.path.join(tmpdir, "mesh.jsonl")
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(captured)
+    if perf_compare.main([os.path.join(_ROOT, "BASELINE.json"),
+                          out_path]) != 0:
+        problems.append("perf_compare gate failed on the mesh legs")
+
+    if problems:
+        for p in problems:
+            print(f"mesh-smoke: FAIL: {p}", file=sys.stderr)
+        return 1
+    rows_bytes = int(samples.get('gol_halo_bytes_total{axis="rows"}', 0))
+    hist_n = int(samples.get("gol_halo_exchange_seconds_count", 0))
+    print(f"mesh-smoke: OK — {len(recs)} gated mesh line(s), "
+          f"{rows_bytes} halo bytes counted on the rows axis, "
+          f"{hist_n} exchange-latency sample(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,7 +134,19 @@ def main() -> int:
                        'method="unknown",q="p50"}',
                        'gol_rpc_latency_ms{kind="handler",'
                        'method="unknown",q="p99"}',
-                       'gol_fleet_queue_wait_ms{q="p95"}'):
+                       'gol_fleet_queue_wait_ms{q="p95"}',
+                       # PR 9 mesh/halo + device-census families
+                       # (axis children pre-seeded in the catalog)
+                       "# TYPE gol_mesh_devices gauge",
+                       "# TYPE gol_mesh_shards gauge",
+                       "# TYPE gol_mesh_axis_size gauge",
+                       "# TYPE gol_halo_exchanges_total counter",
+                       "# TYPE gol_halo_bytes_total counter",
+                       "# TYPE gol_halo_exchange_seconds histogram",
+                       "# TYPE gol_shard_imbalance_ratio gauge",
+                       "# TYPE gol_dev_kind_devices gauge",
+                       "# TYPE gol_dev_mem_stats_supported gauge",
+                       'gol_halo_bytes_total{axis="rows"}'):
             if needle not in body:
                 problems.append(f"/metrics missing {needle!r}")
         if 'gol_profile_captures_total{status="ok"} 1' not in body:
@@ -150,11 +162,16 @@ def main() -> int:
         healthz = json.loads(urllib.request.urlopen(
             base_url + "/healthz", timeout=10).read().decode())
         for field in ("device_kind", "live_bytes", "compile_count",
-                      "runs", "slo"):
+                      "runs", "slo", "mesh"):
             if field not in healthz:
                 problems.append(f"/healthz missing {field!r}")
         if healthz.get("device_kind") != "cpu":
             problems.append(f"/healthz device_kind: {healthz!r}")
+        # The engine stamps its mesh geometry at run start; a 1-thread
+        # CPU run is a 1-device, 1-shard mesh.
+        mesh_f = healthz.get("mesh") or {}
+        if mesh_f.get("devices") != 1 or mesh_f.get("shards") != 1:
+            problems.append(f"/healthz mesh geometry: {mesh_f!r}")
         prof_status = json.loads(urllib.request.urlopen(
             base_url + "/profile", timeout=10).read().decode())
         if prof_status.get("captures_ok") != 1 \

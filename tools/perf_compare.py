@@ -62,7 +62,7 @@ DEFAULT_NOISE_FLOOR = 5.0
 DEFAULT_MAX_REGRESSION = 10.0
 DEFAULT_GATE_PATTERN = (
     r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us"
-    r"|rpc p\d+ ms")
+    r"|rpc p\d+ ms|efficiency_pct|overlap_pct")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
@@ -151,6 +151,12 @@ def load_metrics(path: str) -> Metrics:
 
 
 def _higher_is_better(metric: str, unit: Optional[str]) -> bool:
+    # Scaling-quality percentages (the --mesh legs): explicit rule
+    # FIRST — their unit is "%", which none of the heuristics below
+    # classify, and "overlap" must not fall into any cost bucket.
+    low0 = metric.lower()
+    if "_efficiency_pct" in low0 or "_overlap_pct" in low0:
+        return True
     if unit and (unit.endswith("/s") or unit.endswith("/sec")):
         return True
     if "/sec" in metric or "/s " in metric or "cups" in metric.lower():
