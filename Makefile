@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke chaos-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -60,6 +60,22 @@ load-smoke:
 		| tee out/load_smoke.jsonl
 	python tools/perf_compare.py BASELINE.json out/load_smoke.jsonl
 
+# Chaos-hardening check, CPU-only: bench.py --chaos drives the same
+# seed twice over loopback TCP (clean, then under the seeded GOL_CHAOS
+# fault spec) and must end bit-identical with faults actually injected;
+# the availability_pct floor and rpc_retries_per_call ceiling gate via
+# BASELINE.json (tight flags — the artifact only overlaps the two chaos
+# metrics). tools/chaos_smoke.py then exercises SIGTERM graceful drain,
+# SIGKILL-then-restart (quarantines nothing), and a poisoned fleet run
+# (quarantined exactly once, auto-restored bit-identically).
+chaos-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --chaos \
+		| tee out/chaos_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/chaos_smoke.jsonl \
+		--noise-floor 0.2 --max-regression 1
+	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
 # Multi-device scaling telemetry check, CPU-only with 8 forced host
 # devices: one 4-way bench.py --mesh leg in-process, validating the
 # gol_mesh_*/gol_halo_*/imbalance families, the /healthz mesh stamp,
@@ -70,7 +86,7 @@ mesh-smoke:
 	JAX_PLATFORMS=cpu python tools/mesh_smoke.py
 
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke chaos-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -1369,6 +1369,156 @@ def bench_load(clients: int = LOAD_CLIENTS,
     return rc
 
 
+CHAOS_BOARD = 128
+CHAOS_TURNS = 96
+# ~2% hard-fault rate per wire hook draw (drop+truncate+corrupt), plus
+# a small benign delay share so the latency path is exercised too.
+# Seeded: the same fault schedule on every host. With ~4 hook draws
+# per RPC this puts a transport fault on roughly 1 RPC in 12 — enough
+# that a broken retry layer is unmissable, low enough that the retry
+# budget (2) is effectively never exhausted.
+CHAOS_SPEC = ("drop=0.01,truncate=0.005,corrupt=0.005,"
+              "delay=0.01,delay_ms=2,seed=11")
+
+
+def bench_chaos(n: int = CHAOS_BOARD, turns: int = CHAOS_TURNS,
+                spec: str = CHAOS_SPEC) -> int:
+    """Chaos availability leg (PR 10): the SAME wire-driven run twice —
+    once clean, once under a seeded injected fault rate (GOL_CHAOS) —
+    one ServerDistributor RPC plus one Stats RPC per turn over
+    loopback TCP. The chaos run must end bit-identical to the clean
+    run (and to a device torus replay of the seed): retries + req_id
+    dedupe are allowed to cost latency, never state. Emits two GATED
+    lines over the RETRY-PROTECTED surface (the Stats calls, which go
+    through the client's backoff wrapper): availability_pct (floor —
+    logical calls that succeeded, retries included; a broken retry
+    layer drops this to the raw fault rate) and rpc_retries_per_call
+    (ceiling — retry spend per protected call; a retry storm blows
+    through it). ServerDistributor deliberately bypasses the retry
+    wrapper (a half-run drive must not be blindly re-sent), so its
+    failures are recovered by deterministic app-level reissue and
+    reported in the detail, policed by the parity gate. Hard-fails
+    independently of the perf gate when parity breaks or when no
+    fault was actually injected (a silent chaos no-op must not green
+    the leg)."""
+    import os
+
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import Engine
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.params import Params
+    from gol_tpu.server import EngineServer
+
+    for var in ("GOL_CHAOS", "GOL_RPC_RETRIES", "GOL_RULE",
+                "GOL_CKPT", "GOL_CKPT_EVERY_TURNS"):
+        os.environ.pop(var, None)
+    rng = np.random.default_rng(7)
+    world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
+
+    def drive(label):
+        """Drive the seed `turns` turns, one ServerDistributor RPC plus
+        one retry-protected Stats RPC per turn. A ServerDistributor
+        failure is re-issued at app level from the same
+        (board, start_turn) — it reseeds at start_turn, so a reissue is
+        deterministic. Stats goes through `_call`'s backoff wrapper; a
+        Stats exception means the retry budget itself was exhausted.
+        Returns (board, protected_calls, protected_failures,
+        sd_reissues)."""
+        srv = EngineServer(port=0, host="127.0.0.1", engine=Engine())
+        srv.start_background()
+        try:
+            cli = RemoteEngine(f"127.0.0.1:{srv.port}")
+            p = Params(threads=1, image_width=n, image_height=n,
+                       turns=1)
+            board, turn = world, 0
+            protected = protected_failures = sd_reissues = 0
+            while turn < turns:
+                try:
+                    board, turn = cli.server_distributor(
+                        p, board, start_turn=turn)
+                except Exception as e:
+                    sd_reissues += 1
+                    if sd_reissues > max(8, turns // 8):
+                        raise RuntimeError(
+                            f"{label}: too many drive reissues "
+                            f"({sd_reissues}); last: "
+                            f"{type(e).__name__}: {e}")
+                    time.sleep(0.05)
+                    continue
+                protected += 1
+                try:
+                    cli.stats()
+                except Exception:
+                    protected_failures += 1
+            return board, protected, protected_failures, sd_reissues
+        finally:
+            srv.shutdown()
+
+    # Clean reference first — same seed, no injection.
+    clean_board, _, clean_failures, clean_reissues = drive("clean")
+    if clean_failures or clean_reissues:
+        print(f"BENCH LEG FAILED (chaos): {clean_failures} protected "
+              f"failures / {clean_reissues} reissues with no chaos "
+              f"configured", file=sys.stderr)
+        return 1
+
+    retries0 = sum(c.value for c in
+                   obs_cat.CLIENT_RETRIES.children().values())
+    injected0 = sum(c.value for c in
+                    obs_cat.CHAOS_INJECTED.children().values())
+    os.environ["GOL_CHAOS"] = spec
+    try:
+        chaos_board, calls, failures, sd_reissues = drive("chaos")
+    finally:
+        os.environ.pop("GOL_CHAOS", None)
+    retries = sum(c.value for c in
+                  obs_cat.CLIENT_RETRIES.children().values()) - retries0
+    injected = {
+        "|".join(k) if isinstance(k, tuple) else str(k): int(c.value)
+        for k, c in obs_cat.CHAOS_INJECTED.children().items()}
+    injected_total = sum(injected.values()) - injected0
+
+    parity = bool(np.array_equal(chaos_board, clean_board))
+    oracle = bool(np.array_equal(chaos_board, _fleet_expected(
+        (world != 0).astype(np.uint8), turns)))
+    rc = 0
+    if not parity or not oracle:
+        print(f"PARITY FAIL (chaos): chaos run vs clean={parity}, "
+              f"vs device replay={oracle}", file=sys.stderr)
+        rc |= 1
+    if injected_total <= 0:
+        print("BENCH LEG FAILED (chaos): GOL_CHAOS injected nothing — "
+              "the availability number would be vacuous",
+              file=sys.stderr)
+        rc |= 1
+    availability = 100.0 * (calls - failures) / max(calls, 1)
+    detail = {
+        "size": n, "turns": turns, "spec": spec,
+        "protected_calls": calls, "protected_failures": failures,
+        "sd_reissues": int(sd_reissues),
+        "client_retries": int(retries),
+        "injected_total": int(injected_total),
+        "injected_by_kind": injected,
+        "alive_parity": parity, "oracle_parity": oracle,
+        "parity_check": "chaos-run final board vs clean run AND vs "
+                        "device torus replay, bit-identical",
+        "method": "1 ServerDistributor RPC + 1 retry-protected Stats "
+                  "RPC per turn over loopback TCP under seeded "
+                  "GOL_CHAOS injection; availability/retries are over "
+                  "the Stats calls (the `_call` backoff + req_id "
+                  "surface); ServerDistributor bypasses the wrapper "
+                  "by design and is recovered by deterministic "
+                  "app-level reissue (sd_reissues), policed by the "
+                  "parity gate",
+    }
+    _emit("availability_pct (chaos, wire-driven run)",
+          round(availability, 3), "%", None, detail)
+    _emit("rpc_retries_per_call (chaos, wire-driven run)",
+          round(retries / max(calls, 1), 4), "retries/call", None,
+          detail)
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=None,
@@ -1439,6 +1589,13 @@ def main() -> int:
                     metavar="N",
                     help="with --load: cycles per client (default "
                          f"{LOAD_CYCLES})")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the chaos availability leg only: the "
+                         "same wire-driven run clean and under a "
+                         "seeded ~1% GOL_CHAOS fault rate, "
+                         "bit-identical or fail (emits the gated "
+                         "availability_pct / rpc_retries_per_call "
+                         "lines)")
     ap.add_argument("--mesh", action="store_true",
                     help="run the multi-device scaling legs only: "
                          "strong (fixed 1024²) and weak (256 rows/dev) "
@@ -1547,7 +1704,8 @@ def _dispatch(args, ap) -> int:
     if args.mesh:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
-                or args.fleet or args.load or args.size is not None:
+                or args.fleet or args.load or args.chaos \
+                or args.size is not None:
             ap.error("--mesh is its own config; combine only with "
                      "--mesh-ways/--turns")
         if args.mesh_ways:
@@ -1568,7 +1726,8 @@ def _dispatch(args, ap) -> int:
 
     if args.fleet:
         if args.pattern != "dense" or args.gen or args.engine \
-                or args.ksweep or args.wire or args.overhead:
+                or args.ksweep or args.wire or args.overhead \
+                or args.chaos:
             ap.error("--fleet is its own config; combine only with "
                      "--size/--fleet-runs/--fleet-window")
         if args.fleet_runs:
@@ -1593,7 +1752,7 @@ def _dispatch(args, ap) -> int:
     if args.load:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
-                or args.size is not None:
+                or args.chaos or args.size is not None:
             ap.error("--load is its own config; combine only with "
                      "--load-clients/--load-cycles")
         if (args.load_clients is not None and args.load_clients < 1) \
@@ -1609,6 +1768,16 @@ def _dispatch(args, ap) -> int:
     if args.load_clients is not None or args.load_cycles is not None:
         ap.error("--load-clients/--load-cycles apply to the --load "
                  "leg only")
+
+    if args.chaos:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead:
+            ap.error("--chaos is its own config; combine only with "
+                     "--size/--turns")
+        return bench_chaos(
+            n=args.size if args.size is not None else CHAOS_BOARD,
+            turns=args.turns if args.turns is not None
+            else CHAOS_TURNS)
 
     if args.wire:
         if args.pattern != "dense" or args.gen or args.engine \

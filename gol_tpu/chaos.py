@@ -1,0 +1,236 @@
+"""Deterministic wire-level fault injection (the `GOL_CHAOS` contract).
+
+A seeded injector that wraps the socket send/recv paths in wire.py on
+both the client and the server, so the retry/dedupe/drain/quarantine
+machinery can be exercised under *reproducible* adversity instead of
+waiting for production to supply it. Off by default: when `GOL_CHAOS`
+is unset every hook is a single dict lookup.
+
+Config is a comma-separated key=value string, e.g.::
+
+    GOL_CHAOS=drop=0.01,delay_ms=5,truncate=0.005,corrupt=0.002,stall=0.001,seed=7
+
+Keys (all probabilities are per-message, drawn from ONE seeded RNG so a
+given seed yields the same fault sequence on every run):
+
+- ``drop=p``      close the socket instead of sending/receiving.
+- ``truncate=p``  send a partial header, then close (send side only).
+- ``corrupt=p``   zero one byte inside the JSON header region so the
+                  peer raises WireProtocolError (send side only).
+- ``delay=p`` / ``delay_ms=N``
+                  sleep N ms before the operation. ``delay_ms`` alone
+                  implies ``delay=0.01``.
+- ``stall=p`` / ``stall_ms=N``
+                  long sleep (default 1000 ms) — outlasts typical
+                  client read timeouts, exercising the timeout path.
+- ``seed=N``      RNG seed (default 0).
+- ``poison=<run_id>[@<turn>]``
+                  arm the fleet poison hook: `take_poison(run_id, turn)`
+                  fires exactly once per process when the named run
+                  reaches the given turn, letting the fleet loop
+                  fabricate an implausible popcount. (A real popcount
+                  can never exceed the slot bit capacity, so the
+                  quarantine detector needs a deliberate trigger to be
+                  testable end to end.)
+
+Every injection is metered as ``gol_chaos_injected_total{kind}``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from .obs import catalog as obs
+
+ENV = "GOL_CHAOS"
+
+# Kinds are a closed label set, pre-seeded in obs/catalog.py.
+_INJECTED = {k: obs.CHAOS_INJECTED.labels(kind=k) for k in obs.CHAOS_KINDS}
+
+
+def _parse(spec: str) -> dict:
+    cfg: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        val = val.strip()
+        if key == "poison":
+            cfg[key] = val
+        elif key == "seed":
+            try:
+                cfg[key] = int(val)
+            except ValueError:
+                pass
+        else:
+            try:
+                cfg[key] = float(val)
+            except ValueError:
+                pass
+    return cfg
+
+
+class ChaosInjector:
+    """One seeded fault plan, shared by every connection in the process."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        cfg = _parse(spec)
+        self.drop = float(cfg.get("drop", 0.0))
+        self.truncate = float(cfg.get("truncate", 0.0))
+        self.corrupt = float(cfg.get("corrupt", 0.0))
+        self.delay_ms = float(cfg.get("delay_ms", 0.0))
+        self.delay = float(cfg.get("delay",
+                                   0.01 if self.delay_ms > 0 else 0.0))
+        self.stall = float(cfg.get("stall", 0.0))
+        self.stall_ms = float(cfg.get("stall_ms", 1000.0))
+        self._rng = random.Random(int(cfg.get("seed", 0)))
+        self._lock = threading.Lock()
+        # poison=<run_id>[@<turn>] — one-shot fleet popcount poison.
+        self._poison_run: Optional[str] = None
+        self._poison_turn = 0
+        self._poison_fired = False
+        poison = cfg.get("poison")
+        if poison:
+            rid, _, turn = str(poison).partition("@")
+            self._poison_run = rid
+            try:
+                self._poison_turn = int(turn) if turn else 0
+            except ValueError:
+                self._poison_turn = 0
+
+    # -- fault plan ---------------------------------------------------
+    def _plan(self, kinds) -> Optional[str]:
+        """One uniform draw walked over the cumulative per-kind
+        probabilities; None means the message passes clean."""
+        with self._lock:
+            r = self._rng.random()
+        acc = 0.0
+        for kind, p in kinds:
+            acc += p
+            if r < acc:
+                return kind
+        return None
+
+    def on_send(self, sock, head: bytes) -> bytes:
+        """Called by wire.send_msg with the framed header bytes (4-byte
+        length prefix + JSON). Returns the (possibly corrupted) header,
+        sleeps, or closes the socket and raises ConnectionError."""
+        kind = self._plan((("drop", self.drop),
+                           ("truncate", self.truncate),
+                           ("corrupt", self.corrupt),
+                           ("delay", self.delay),
+                           ("stall", self.stall)))
+        if kind is None:
+            return head
+        _INJECTED[kind].inc()
+        if kind == "drop":
+            _close_quiet(sock)
+            raise ConnectionError("chaos: dropped send")
+        if kind == "truncate":
+            # Partial header, then hard close: the peer sees a
+            # mid-message EOF, the sender a ConnectionError.
+            cut = max(1, len(head) // 2)
+            try:
+                sock.sendall(head[:cut])
+            except OSError:
+                pass
+            _close_quiet(sock)
+            raise ConnectionError("chaos: truncated send")
+        if kind == "corrupt":
+            # Zero one byte inside the JSON region (never the length
+            # prefix) — guaranteed-invalid JSON, so the peer raises
+            # WireProtocolError instead of acting on garbage.
+            buf = bytearray(head)
+            with self._lock:
+                i = self._rng.randrange(4, len(buf)) if len(buf) > 4 else 0
+            if i >= 4:
+                buf[i] = 0x00
+            return bytes(buf)
+        if kind == "stall":
+            time.sleep(self.stall_ms / 1000.0)
+        else:  # delay
+            time.sleep(self.delay_ms / 1000.0)
+        return head
+
+    def on_recv(self, sock) -> None:
+        """Called at the top of wire.recv_msg. Truncate/corrupt are
+        send-shaped faults; the recv side draws only drop/delay/stall."""
+        kind = self._plan((("drop", self.drop),
+                           ("delay", self.delay),
+                           ("stall", self.stall)))
+        if kind is None:
+            return
+        _INJECTED[kind].inc()
+        if kind == "drop":
+            _close_quiet(sock)
+            raise ConnectionError("chaos: dropped recv")
+        if kind == "stall":
+            time.sleep(self.stall_ms / 1000.0)
+        else:
+            time.sleep(self.delay_ms / 1000.0)
+
+    def take_poison(self, run_id: str, turn: int) -> bool:
+        """True exactly once, when the armed run reaches the armed turn."""
+        if self._poison_run is None or self._poison_fired:
+            return False
+        if run_id != self._poison_run or turn < self._poison_turn:
+            return False
+        with self._lock:
+            if self._poison_fired:
+                return False
+            self._poison_fired = True
+        return True
+
+
+def _close_quiet(sock) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+_BUILD_LOCK = threading.Lock()
+_STATE: Optional[ChaosInjector] = None
+
+
+def injector() -> Optional[ChaosInjector]:
+    """The process-wide injector for the current GOL_CHAOS value, or
+    None (the fast path) when chaos is off. Rebuilt — fresh RNG and
+    poison state — whenever the env value changes."""
+    raw = os.environ.get(ENV, "")
+    if not raw:
+        return None
+    global _STATE
+    st = _STATE
+    if st is not None and st.spec == raw:
+        return st
+    with _BUILD_LOCK:
+        st = _STATE
+        if st is None or st.spec != raw:
+            _STATE = st = ChaosInjector(raw)
+    return st
+
+
+# -- wire.py hook surface (single call, no-op when chaos is off) ------
+
+def send_hook(sock, head: bytes) -> bytes:
+    inj = injector()
+    return head if inj is None else inj.on_send(sock, head)
+
+
+def recv_hook(sock) -> None:
+    inj = injector()
+    if inj is not None:
+        inj.on_recv(sock)
+
+
+def take_poison(run_id: str, turn: int) -> bool:
+    inj = injector()
+    return False if inj is None else inj.take_poison(run_id, turn)

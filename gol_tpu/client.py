@@ -19,6 +19,7 @@ lost — the watchdog stands down.
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
@@ -42,6 +43,40 @@ HB_INTERVAL_ENV = "GOL_HB_INTERVAL"   # seconds between pings; 0 disables
 HB_MISSES_ENV = "GOL_HB_MISSES"       # consecutive failures before loss
 HB_INTERVAL_DEFAULT = 2.0
 HB_MISSES_DEFAULT = 3
+
+# Retry policy for one-shot RPCs through _call (the long blocking
+# ServerDistributor call has its own watchdog and is never retried):
+# up to GOL_RPC_RETRIES re-attempts after a TRANSPORT failure (tagged
+# with .rpc_error_kind by _call_once), under exponential backoff with
+# jitter. Errors the server actually replied with (killed/busy/
+# overloaded/engine errors via _check_resp) are never retried — the
+# request was delivered and answered.
+RETRIES_ENV = "GOL_RPC_RETRIES"
+RETRIES_DEFAULT = 2
+RETRY_BACKOFF_BASE_S = 0.05
+RETRY_BACKOFF_CAP_S = 2.0
+# Per-method budgets that beat the env default: Ping is the heartbeat
+# watchdog's loss probe (internal retries would stretch the detection
+# window hb_misses x hb_interval); KillProg's server may exit before
+# replying by design.
+METHOD_RETRY_BUDGETS = {"Ping": 0, "KillProg": 0}
+
+# Methods that mutate server state: stamped with a client-generated
+# req_id header (stable across retries) so the server's dedupe window
+# makes the retry idempotent. Read-only methods are naturally safe.
+MUTATING_METHODS = frozenset({
+    "CreateRun", "DestroyRun", "Checkpoint", "CFput", "DrainFlags",
+    "RestoreRun", "AbortRun", "Profile", "KillProg",
+})
+
+
+def _transport_error(msg: str, kind: str) -> ConnectionError:
+    """A ConnectionError tagged with its transport-failure kind
+    (timeout/refused/reset/protocol) — the tag is what authorizes a
+    retry and attributes the flight-recorder event."""
+    e = ConnectionError(msg)
+    e.rpc_error_kind = kind
+    return e
 
 
 def _check_resp(resp: dict):
@@ -106,21 +141,89 @@ class RemoteEngine:
         header.setdefault("caps", sorted(wire.local_caps()))
         if self.run_id is not None:
             header.setdefault("run_id", self.run_id)
+        if label in MUTATING_METHODS:
+            # One id for ALL attempts of this logical request: a retry
+            # whose first attempt already committed replays the cached
+            # reply from the server's dedupe window instead of
+            # re-executing.
+            header.setdefault("req_id", uuid.uuid4().hex)
+        # minimum=0: GOL_RPC_RETRIES=0 must genuinely disable retries
+        # (the operator's escape hatch, and what the tests pin).
+        budget = METHOD_RETRY_BUDGETS.get(
+            label, env_int(RETRIES_ENV, RETRIES_DEFAULT, minimum=0))
+        attempt = 0
+        while True:
+            try:
+                resp, resp_world = self._call_once(
+                    label, header, world, timeout, xrle_basis)
+            except ConnectionError as e:
+                kind = getattr(e, "rpc_error_kind", None)
+                if kind is None or attempt >= budget:
+                    raise
+                attempt += 1
+                obs.CLIENT_RETRIES.labels(method=label).inc()
+                obs_log("client.rpc_retry", level="warning", method=label,
+                        kind=kind, attempt=attempt, error=str(e))
+                delay = min(RETRY_BACKOFF_CAP_S,
+                            RETRY_BACKOFF_BASE_S * (2 ** (attempt - 1)))
+                time.sleep(delay * (0.5 + random.random() * 0.5))
+                continue
+            self._note_caps(resp)
+            _check_resp(resp)
+            return resp, resp_world
+
+    def _call_once(self, label: str, header: dict, world, timeout,
+                   xrle_basis):
+        """One connect+send+recv attempt. Transport failures surface as
+        ConnectionError tagged with .rpc_error_kind (timeout / refused /
+        reset / protocol) so the retry wrapper and flight events can
+        tell a dead server from a slow one from a garbage peer."""
         obs.CLIENT_REQUESTS.labels(method=label).inc()
+        addr = f"{self._addr[0]}:{self._addr[1]}"
         t0 = time.monotonic()
         # The span sits on this thread's context stack while send_msg
         # runs, so the wire codec stamps its id into the header as "tc"
         # and the server handler span parents under it.
         with trace.span(f"rpc.{label}"):
             try:
-                sock = socket.create_connection(
-                    self._addr, timeout=self._timeout)
+                try:
+                    sock = socket.create_connection(
+                        self._addr, timeout=self._timeout)
+                except (socket.timeout, TimeoutError) as e:
+                    raise _transport_error(
+                        f"connect timeout to {addr} after "
+                        f"{self._timeout}s ({label}): {e}",
+                        "timeout") from e
+                except ConnectionRefusedError as e:
+                    raise _transport_error(
+                        f"connect refused by {addr} ({label}): {e}",
+                        "refused") from e
+                except OSError as e:
+                    raise _transport_error(
+                        f"connect to {addr} failed ({label}): {e}",
+                        "refused") from e
                 try:
                     wire.enable_nodelay(sock)
                     sock.settimeout(timeout)  # None → block (long run call)
-                    send_msg(sock, header, world)
-                    resp, resp_world = recv_msg(sock,
-                                                xrle_basis=xrle_basis)
+                    try:
+                        send_msg(sock, header, world)
+                        resp, resp_world = recv_msg(sock,
+                                                    xrle_basis=xrle_basis)
+                    except wire.WireProtocolError as e:
+                        e.rpc_error_kind = "protocol"
+                        raise
+                    except (socket.timeout, TimeoutError) as e:
+                        raise _transport_error(
+                            f"read timeout from {addr} after {timeout}s "
+                            f"mid-{label}: {e}", "timeout") from e
+                    except ConnectionError as e:
+                        raise _transport_error(
+                            f"connection reset by {addr} mid-{label}: "
+                            f"{e}", "reset") from e
+                    except OSError as e:
+                        raise _transport_error(
+                            f"socket error from {addr} mid-{label}: {e}",
+                            "reset") from e
                 finally:
                     sock.close()
             except (ConnectionError, OSError):
@@ -133,8 +236,6 @@ class RemoteEngine:
                 # End-to-end observed latency: connect + send + server
                 # service + receive — what this caller experienced.
                 obs_slo.observe_rpc("client", label, t1 - t0, now=t1)
-        self._note_caps(resp)
-        _check_resp(resp)
         return resp, resp_world
 
     # --- Engine interface -------------------------------------------------

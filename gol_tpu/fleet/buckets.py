@@ -199,6 +199,28 @@ class Bucket:
         self.free.append(slot)
         return board
 
+    def release(self, slot: int) -> None:
+        """Free a slot WITHOUT reading its board back — the quarantine
+        eviction: a faulted slot's contents are untrusted by definition
+        (and after a step exception the device array itself may be
+        unusable), so nothing is salvaged from it."""
+        self.slots[slot] = None
+        if slot not in self.free:
+            self.free.append(slot)
+
+    def rebuild(self) -> None:
+        """Recreate the device array from scratch and restamp every
+        still-placed handle from its host `frozen` board. The step-
+        exception recovery path: after a dispatch raised, the old
+        `self.words` may hold a poisoned or unusable buffer; paused/
+        parked residents have authoritative host copies, and faulted
+        actives were released before this call."""
+        self.words = jnp.zeros((self.cap, self.hb, self.wpb),
+                               dtype=jnp.uint32)
+        for slot, h in enumerate(self.slots):
+            if h is not None and h.frozen is not None:
+                self.stamp(slot, h.frozen)
+
     # --------------------------------------------------------- dispatch
 
     def signature_key(self, turns: int) -> tuple:

@@ -76,8 +76,10 @@ from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import devstats as obs_devstats
 from gol_tpu.obs import slo as obs_slo
 from gol_tpu.obs import timeline as obs_timeline
+from gol_tpu.obs.log import exception as obs_exception
+from gol_tpu.obs.log import log as obs_log
 from gol_tpu.ops.bitpack import WORD_BITS, packed_run_turns
-from gol_tpu.utils.envcfg import env_int
+from gol_tpu.utils.envcfg import env_float, env_int
 
 BUCKETS_ENV = "GOL_FLEET_BUCKETS"     # csv of square class sides
 CHUNK_ENV = "GOL_FLEET_CHUNK"         # serving quantum in turns
@@ -88,6 +90,17 @@ METRICS_FLUSH_SECONDS = 0.5  # same batched-flush cadence as engine.py
 
 # How long create_run/load_checkpoint wait for the loop to place a run.
 _PLACE_TIMEOUT_S = 60.0
+
+# Quarantine auto-restore policy: a faulted run is re-seeded from its
+# last per-run checkpoint at most TRIES times, spaced by exponential
+# backoff starting at BACKOFF seconds. Past the cap the run stays
+# "quarantined" until an operator destroys (or reseeds) it — a board
+# that faults repeatedly must never oscillate back into the shared
+# batched dispatch.
+QUARANTINE_TRIES_ENV = "GOL_QUARANTINE_TRIES"
+QUARANTINE_TRIES_DEFAULT = 3
+QUARANTINE_BACKOFF_ENV = "GOL_QUARANTINE_BACKOFF"
+QUARANTINE_BACKOFF_DEFAULT_S = 0.5
 
 
 def _parse_sizes(raw: str) -> Tuple[int, ...]:
@@ -207,6 +220,7 @@ class FleetEngine(ControlFlagProtocol):
                 "resident": by_state.get("resident", 0),
                 "queued": by_state.get("queued", 0),
                 "parked": by_state.get("parked", 0),
+                "quarantined": by_state.get("quarantined", 0),
                 "total": len(self._runs),
                 "engine": "FleetEngine",
             }
@@ -583,6 +597,30 @@ class FleetEngine(ControlFlagProtocol):
         h = self._legacy_or_raise()
         return self._ckpt_sync(h, directory, trigger)
 
+    def checkpoint_fleet(self, trigger: str = "sigterm") -> int:
+        """Durably checkpoint every fleet run that has a board (the
+        graceful-drain hook): returns how many runs were written. The
+        legacy run is excluded — the SIGTERM handler already writes it
+        through the pre-fleet path. Per-run failures are logged and
+        skipped; drain must make progress past one bad run."""
+        base = os.environ.get(CKPT_ENV, "")
+        if not base:
+            return 0
+        with self._fleet_lock:
+            handles = [
+                h for h in self._runs.values()
+                if h.run_id != LEGACY_RUN_ID
+                and (h.state == "resident" or h.frozen is not None)]
+        n = 0
+        for h in handles:
+            try:
+                self._ckpt_sync(h, None, trigger)
+                n += 1
+            except Exception as e:
+                obs_exception("fleet.drain_ckpt_failed", e,
+                              run_id=h.run_id)
+        return n
+
     def _ckpt_dir(self, run_id: str, base: str) -> str:
         """Per-run checkpoint directory: the legacy run keeps writing
         at the configured root (pre-fleet resume tooling reads there);
@@ -888,14 +926,24 @@ class FleetEngine(ControlFlagProtocol):
                     continue
                 key, bucket = picked
                 chunk = self.chunk_turns
-                alive_dev = bucket.dispatch(chunk)
+                try:
+                    alive_dev = bucket.dispatch(chunk)
+                except Exception as e:
+                    self._dispatch_failed_locked(bucket, e)
+                    continue
                 stepped: List[Tuple[int, RunHandle]] = []
                 for slot, h in enumerate(bucket.slots):
                     if h is not None and h.active:
                         h.turn += chunk
                         stepped.append((slot, h))
             t_disp = time.monotonic()
-            alive_host = np.asarray(alive_dev)  # the device wait point
+            try:
+                alive_host = np.asarray(alive_dev)  # the device wait point
+            except Exception as e:
+                # The dispatch was async; the fault surfaces at the sync.
+                with self._wake:
+                    self._dispatch_failed_locked(bucket, e, stepped)
+                continue
             t_done = time.monotonic()
             with self._wake:
                 rotation = t_done - last_end.get(key, t0)
@@ -903,11 +951,25 @@ class FleetEngine(ControlFlagProtocol):
                 useful_cells = 0
                 run_ids: List[str] = []
                 top_turn = 0
+                slot_bits = bucket.hb * bucket.wb
+                poison_on = bool(os.environ.get("GOL_CHAOS"))
                 for slot, h in stepped:
                     if h.state != "resident":
                         continue  # parked/removed while we waited
                     tiles = tiles_for(h.h, h.w, bucket.hb, bucket.wb)
-                    h.alive = crop_alive(int(alive_host[slot]), tiles)
+                    raw_alive = int(alive_host[slot])
+                    if poison_on:
+                        from gol_tpu import chaos
+
+                        if chaos.take_poison(h.run_id, h.turn):
+                            raw_alive = -1  # fabricated device fault
+                    if not 0 <= raw_alive <= slot_bits:
+                        # A popcount outside [0, slot bits] cannot come
+                        # from a healthy dispatch: the slot's words are
+                        # untrusted. Evict without readback.
+                        self._quarantine_locked(bucket, h, "popcount")
+                        continue
+                    h.alive = crop_alive(raw_alive, tiles)
                     h.alive_turn = h.turn
                     h.advanced_s = t_done
                     useful_cells += h.h * h.w
@@ -1064,12 +1126,23 @@ class FleetEngine(ControlFlagProtocol):
             h.frozen = None
             h.state = "resident"
             h.advanced_s = time.monotonic()
-        # Per-run: seeds, flags, resumes, trims/completions.
+        # Per-run: quarantine restores, seeds, flags, resumes, trims.
         for h in list(self._runs.values()):
             if h.state == "removed":
                 continue
+            if h.state == "quarantined":
+                self._service_quarantined_locked(h)
             if h.pending_seed is not None:
-                self._apply_seed_locked(h)
+                try:
+                    self._apply_seed_locked(h)
+                except Exception as e:
+                    # A seed/restore that cannot be installed leaves the
+                    # run with no trustworthy board: quarantine it (the
+                    # auto-restore path retries from its checkpoint).
+                    h.pending_seed = None
+                    obs_exception("fleet.seed_failed", e, run_id=h.run_id)
+                    self._quarantine_locked(
+                        self._buckets.get(h.bucket_key), h, "restore")
             if not h.flags.empty():
                 self._service_flags_locked(h)
             if h.abort.is_set():
@@ -1099,6 +1172,12 @@ class FleetEngine(ControlFlagProtocol):
             self._buckets[h.bucket_key].stamp(h.slot, board01)
         else:
             h.frozen = board01
+            if h.state == "quarantined":
+                # An explicit reseed is operator recovery: requeue the
+                # run for placement with fresh quarantine bookkeeping.
+                h.state = "queued"
+                h.quarantine_next_s = 0.0
+                self._placeq.append(h)
         if h.run_id == LEGACY_RUN_ID:
             with self._state_lock:
                 self._turn = h.turn
@@ -1155,6 +1234,136 @@ class FleetEngine(ControlFlagProtocol):
             h.frozen = None
         h.state = "resident"
 
+    # ------------------------------------------------------- quarantine
+
+    def _quarantine_locked(self, bucket: Optional[Bucket], h: RunHandle,
+                           reason: str) -> None:
+        """Evict a faulted run from the shared batch: its slot frees
+        WITHOUT readback (the contents are untrusted by definition) and
+        its host copy is discarded. The admission charge stays held —
+        auto-restore re-places the run without re-admission, so a
+        transient fault cannot lose the run its capacity to a waiter."""
+        reason = obs.quarantine_label(reason)
+        if h.slot is not None and bucket is not None:
+            bucket.release(h.slot)
+        h.slot = None
+        h.frozen = None
+        h.paused = False
+        h.state = "quarantined"
+        h.quarantine_reason = reason
+        h.quarantine_tries = 0
+        h.quarantine_next_s = 0.0  # first restore attempt: immediately
+        obs.RUNS_QUARANTINED.labels(reason=reason).inc()
+        obs_log("fleet.quarantine", level="error", run_id=h.run_id,
+                reason=reason, turn=h.turn)
+        # NOTE: h.done is NOT set — a driven run stays driven; the
+        # restore path re-queues it and the drive completes normally.
+        # Only exhausted restores (below) release waiting drivers.
+
+    def _dispatch_failed_locked(self, bucket: Bucket, exc: Exception,
+                                stepped: Optional[List[Tuple[int,
+                                                   RunHandle]]] = None,
+                                ) -> None:
+        """A batched dispatch (or its device sync) raised: no slot of
+        this bucket produced a trustworthy result. Quarantine every run
+        that was being stepped and rebuild the device array from the
+        host copies of the survivors (paused/parked residents)."""
+        obs_exception("fleet.dispatch_failed", exc,
+                      bucket=f"{bucket.hb}x{bucket.wb}")
+        if stepped is None:  # dispatch itself raised: turns never moved
+            victims = [(s, h) for s, h in enumerate(bucket.slots)
+                       if h is not None and h.active]
+        else:  # sync raised: roll the optimistic turn advance back
+            victims = stepped
+            for _slot, h in victims:
+                if h.state == "resident":
+                    h.turn -= self.chunk_turns
+        for _slot, h in victims:
+            if h.state == "resident":
+                self._quarantine_locked(bucket, h, "step")
+        try:
+            bucket.rebuild()
+        except Exception as e:  # device truly wedged; keep serving rest
+            obs_exception("fleet.bucket_rebuild_failed", e,
+                          bucket=f"{bucket.hb}x{bucket.wb}")
+
+    def _service_quarantined_locked(self, h: RunHandle) -> None:
+        """Capped, backed-off auto-restore: re-seed a quarantined run
+        from its newest durable per-run checkpoint and requeue it for
+        placement. Past the try cap the run is left quarantined and any
+        waiting driver is released (it will surface the failure)."""
+        now = time.monotonic()
+        if now < h.quarantine_next_s:
+            return
+        max_tries = env_int(QUARANTINE_TRIES_ENV,
+                            QUARANTINE_TRIES_DEFAULT, minimum=0)
+        if h.quarantine_tries >= max_tries:
+            return
+        h.quarantine_tries += 1
+        try:
+            board01, turn = self._load_run_ckpt(h)
+        except Exception as e:
+            obs.RUNS_QUARANTINE_RESTORES.labels(status="error").inc()
+            backoff = env_float(QUARANTINE_BACKOFF_ENV,
+                                QUARANTINE_BACKOFF_DEFAULT_S)
+            h.quarantine_next_s = now + backoff * (
+                2 ** (h.quarantine_tries - 1))
+            obs_log("fleet.quarantine_restore_failed", level="error",
+                    run_id=h.run_id, attempt=h.quarantine_tries,
+                    error=f"{type(e).__name__}: {e}")
+            if h.quarantine_tries >= max_tries:
+                obs_log("fleet.quarantine_terminal", level="error",
+                        run_id=h.run_id, tries=h.quarantine_tries)
+                h.done.set()  # drivers must not wait on a dead run
+            return
+        h.frozen = board01
+        h.turn = int(turn)
+        h.alive = int(board01.sum())
+        h.alive_turn = h.turn
+        if h.ckpt_every:
+            h.next_ckpt_turn = h.turn + h.ckpt_every
+        h.state = "queued"
+        self._placeq.append(h)
+        obs.RUNS_QUARANTINE_RESTORES.labels(status="ok").inc()
+        obs_log("fleet.quarantine_restored", run_id=h.run_id,
+                turn=h.turn, attempt=h.quarantine_tries,
+                reason=h.quarantine_reason)
+
+    def _load_run_ckpt(self, h: RunHandle) -> Tuple[np.ndarray, int]:
+        """(board01, turn) from the run's newest durable checkpoint,
+        verified end-to-end before any byte is trusted."""
+        from gol_tpu.ckpt import manifest as mf
+
+        base = os.environ.get(CKPT_ENV, "")
+        if not base:
+            raise RuntimeError(
+                "checkpointing not configured (set GOL_CKPT)")
+        directory = self._ckpt_dir(h.run_id, base)
+        latest = mf.latest_checkpoint(directory)
+        if latest is None:
+            raise FileNotFoundError(
+                f"no durable checkpoint for run {h.run_id} "
+                f"in {directory}")
+        target = latest[1]
+        m = mf.verify_manifest(target)
+        payload = mf.payload_path(target, m)
+        with np.load(payload) as z:
+            turn = int(z["turn"])
+            if "world" in z.files:
+                board01 = (np.asarray(z["world"]) != 0).astype(np.uint8)
+            elif "words" in z.files:
+                words = np.asarray(z["words"])
+                board01 = words_to_board(words, words.shape[-2],
+                                         int(z["width"]))
+            else:
+                raise ValueError(
+                    f"unsupported payload members: {sorted(z.files)}")
+        if board01.shape != (h.h, h.w):
+            raise ValueError(
+                f"checkpoint board {board01.shape} does not match "
+                f"run {(h.h, h.w)}")
+        return np.ascontiguousarray(board01), turn
+
     def _remove_locked(self, h: RunHandle) -> None:
         """Terminal: free the slot, return the admission charge, drop
         the handle from the registry. The final board stays on
@@ -1171,7 +1380,8 @@ class FleetEngine(ControlFlagProtocol):
             h.slot = None
             if h.admitted_cost:
                 self.admission.release(h.admitted_cost)
-        elif h.state in ("queued", "parked") and h.admitted_cost:
+        elif (h.state in ("queued", "parked", "quarantined")
+              and h.admitted_cost):
             self.admission.release(h.admitted_cost)
         h.state = "removed"
         if h.ckpt_writer is not None:

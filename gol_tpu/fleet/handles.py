@@ -46,13 +46,19 @@ from gol_tpu.wire import valid_run_id  # noqa: F401  (re-exported)
 LEGACY_RUN_ID = "run0"
 
 # RunHandle.state values (ListRuns/AttachRun expose them verbatim):
-#   queued   - admitted to the wait queue, no device placement yet
-#   resident - placed in a bucket slot; stepped while active
-#   parked   - resident but frozen (target reached, FLAG_QUIT on an
-#              active run, or pause): board durable on the handle,
-#              readable, not stepped
-#   removed  - slot released, capacity returned; terminal
-RUN_STATES = ("queued", "resident", "parked", "removed")
+#   queued      - admitted to the wait queue, no device placement yet
+#   resident    - placed in a bucket slot; stepped while active
+#   parked      - resident but frozen (target reached, FLAG_QUIT on an
+#                 active run, or pause): board durable on the handle,
+#                 readable, not stepped
+#   quarantined - slot faulted (implausible popcount, step exception,
+#                 restore failure): evicted from its bucket with the
+#                 board DISCARDED as untrusted, admission charge held;
+#                 the fleet loop auto-restores from the run's last
+#                 per-run checkpoint under capped backoff
+#                 (GOL_QUARANTINE_TRIES), re-queueing it for placement
+#   removed     - slot released, capacity returned; terminal
+RUN_STATES = ("queued", "resident", "parked", "quarantined", "removed")
 
 
 class FleetUnsupported(RuntimeError):
@@ -68,6 +74,7 @@ class RunHandle:
         "viewers", "ckpt_every", "next_ckpt_turn", "target_turn",
         "done", "created_s", "pending_seed", "ckpt_writer", "abort",
         "admitted_cost", "enqueued_s", "advanced_s",
+        "quarantine_reason", "quarantine_tries", "quarantine_next_s",
     )
 
     def __init__(self, run_id: str, rule, h: int, w: int,
@@ -117,6 +124,12 @@ class RunHandle:
         # TURN STALENESS signal (now - advanced_s) from it.
         self.enqueued_s: Optional[float] = None
         self.advanced_s = time.monotonic()
+        # Quarantine bookkeeping (PR 10): why the run left residency,
+        # restore attempts so far, and the monotonic time before which
+        # the fleet loop must not retry (exponential backoff).
+        self.quarantine_reason: Optional[str] = None
+        self.quarantine_tries = 0
+        self.quarantine_next_s = 0.0
 
     @property
     def active(self) -> bool:
@@ -125,7 +138,7 @@ class RunHandle:
 
     def describe(self) -> dict:
         """The ListRuns/AttachRun record for this run."""
-        return {
+        rec = {
             "run_id": self.run_id,
             "state": self.state,
             "board": [self.h, self.w],
@@ -140,6 +153,12 @@ class RunHandle:
             "ckpt_every": self.ckpt_every,
             "target_turn": self.target_turn,
         }
+        # Only quarantined (or once-quarantined) runs carry the key, so
+        # the record shape is unchanged for every healthy run.
+        if self.quarantine_reason is not None:
+            rec["quarantine_reason"] = self.quarantine_reason
+            rec["quarantine_tries"] = self.quarantine_tries
+        return rec
 
 
 class SingleRunSurface:

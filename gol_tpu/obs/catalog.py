@@ -179,6 +179,66 @@ def method_label(method: str) -> str:
     return method if method in WIRE_METHODS else "unknown"
 
 
+# ------------------------------------------------- chaos & fault tolerance
+
+# Closed kind sets, pre-seeded like the wire methods so the resilience
+# families are visible at zero before the first fault.
+CHAOS_KINDS = ("drop", "delay", "truncate", "corrupt", "stall")
+RPC_ERROR_KINDS = ("timeout", "refused", "reset", "protocol")
+
+CHAOS_INJECTED = REGISTRY.counter(
+    "gol_chaos_injected_total",
+    "Faults injected by the GOL_CHAOS wire-layer injector "
+    "(gol_tpu/chaos.py), by kind: drop (socket closed instead of the "
+    "operation), delay (bounded sleep), truncate (partial header then "
+    "close), corrupt (one header byte zeroed so the peer sees a "
+    "protocol error), stall (long sleep that outlasts read timeouts). "
+    "Stays 0 unless GOL_CHAOS is set.",
+    label_names=("kind",))
+for _k in CHAOS_KINDS:
+    CHAOS_INJECTED.labels(kind=_k)
+
+RPC_ERRORS = REGISTRY.counter(
+    "gol_rpc_errors_total",
+    "Transport-level RPC failures observed in the server per-connection "
+    "handler, by method and kind: timeout (header/read deadline), "
+    "refused (connect-phase failure), reset (peer closed or OS error "
+    "mid-message), protocol (unparseable framing).",
+    label_names=("method", "kind"))
+for _m in WIRE_METHODS:
+    for _k in RPC_ERROR_KINDS:
+        RPC_ERRORS.labels(method=_m, kind=_k)
+
+
+def rpc_error_kind_label(kind: str) -> str:
+    """Clamp arbitrary transport-error kinds to the declared set."""
+    return kind if kind in RPC_ERROR_KINDS else "reset"
+
+
+CLIENT_RETRIES = REGISTRY.counter(
+    "gol_client_retries_total",
+    "RPC attempts re-issued by the RemoteEngine retry policy "
+    "(exponential backoff with jitter) after a retryable transport "
+    "error, by wire method. Excludes the first attempt.",
+    label_names=("method",))
+
+SERVER_DEDUP_HITS = REGISTRY.counter(
+    "gol_server_dedup_hits_total",
+    "Mutating requests answered from the server-side req_id dedupe "
+    "window instead of re-executing (a retried RPC whose first attempt "
+    "already committed), by wire method.",
+    label_names=("method",))
+
+SERVER_DRAIN_SECONDS = REGISTRY.gauge(
+    "gol_server_drain_seconds",
+    "Wall seconds the last graceful drain (SIGTERM) spent between "
+    "stopping the accept loop and process exit: in-flight handler "
+    "wait plus checkpointing.")
+SERVER_DRAIN_INFLIGHT = REGISTRY.gauge(
+    "gol_server_drain_inflight",
+    "In-flight request handlers observed when the last graceful drain "
+    "began.")
+
 # ------------------------------------------------------------ fleet runs
 
 RUNS_RESIDENT = REGISTRY.gauge(
@@ -216,6 +276,35 @@ RUNS_DESTROYED = REGISTRY.counter(
     "DestroyRun removals: runs explicitly destroyed over the wire (or "
     "via FleetEngine.destroy_run), freeing their bucket slot and "
     "admission budget. QUIT/KILL-flag removals are not counted here.")
+
+RUNS_QUARANTINED = REGISTRY.counter(
+    "gol_runs_quarantined_total",
+    "Runs evicted from their fleet bucket into state 'quarantined', by "
+    "reason: popcount (implausible per-slot alive count), step (the "
+    "shared bucket dispatch raised), restore (seed/restore of the slot "
+    "failed). A quarantined board never re-enters the shared lax.scan "
+    "dispatch until auto-restored from its last checkpoint.",
+    label_names=("reason",))
+
+QUARANTINE_REASONS = ("popcount", "step", "restore", "unknown")
+for _r in QUARANTINE_REASONS:
+    RUNS_QUARANTINED.labels(reason=_r)
+
+
+def quarantine_label(reason: str) -> str:
+    """Clamp arbitrary quarantine reasons to the declared set."""
+    return reason if reason in QUARANTINE_REASONS else "unknown"
+
+
+RUNS_QUARANTINE_RESTORES = REGISTRY.counter(
+    "gol_runs_quarantine_restores_total",
+    "Auto-restore attempts for quarantined runs from their last per-run "
+    "checkpoint, by outcome: ok (run re-queued for placement), error "
+    "(no usable checkpoint or restore raised; retried under backoff up "
+    "to GOL_QUARANTINE_TRIES).",
+    label_names=("status",))
+for _s in ("ok", "error"):
+    RUNS_QUARANTINE_RESTORES.labels(status=_s)
 
 
 def runs_doc() -> dict:

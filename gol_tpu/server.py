@@ -50,6 +50,11 @@ HEADER_TIMEOUT_DEFAULT = 30.0
 MAX_CONNS_ENV = "GOL_MAX_CONNS"           # concurrent connections; 0 = off
 MAX_CONNS_DEFAULT = 64
 
+# Graceful drain (SIGTERM): stop accepting, wait up to this many seconds
+# for in-flight handlers, checkpoint, then exit 0.
+DRAIN_DEADLINE_ENV = "GOL_DRAIN_DEADLINE"
+DRAIN_DEADLINE_DEFAULT = 5.0
+
 
 class EngineServer:
     def __init__(
@@ -77,8 +82,29 @@ class EngineServer:
         # only costs one full-frame resend.
         self._view_cache: dict = {}
         self._view_cache_lock = threading.Lock()
+        # req_id dedupe window: the last DEDUPE_MAX mutating replies,
+        # keyed by "<method>|<req_id>", so a client retry whose first
+        # attempt already committed replays the recorded reply instead
+        # of re-executing (idempotent retries). Raw-u8 legacy peers
+        # never send req_id and keep today's at-most-once semantics.
+        self._dedupe: dict = {}
+        self._dedupe_lock = threading.Lock()
+        self._dedupe_ctx = threading.local()
+        # In-flight handler census for graceful drain.
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
     VIEW_CACHE_MAX = 4
+    DEDUPE_MAX = 512
+    # How long a duplicate waits for the original attempt to record its
+    # reply before giving up (the original may be a long Checkpoint).
+    DEDUPE_WAIT_S = 60.0
+    # Mirror of the client's MUTATING_METHODS: the set whose replies are
+    # recorded for replay. Read-only methods are naturally idempotent.
+    MUTATING_METHODS = frozenset({
+        "CreateRun", "DestroyRun", "Checkpoint", "CFput", "DrainFlags",
+        "RestoreRun", "AbortRun", "Profile", "KillProg",
+    })
 
     def serve_forever(self) -> None:
         while not self._shutdown.is_set():
@@ -93,6 +119,13 @@ class EngineServer:
             # the SLO layer reports it as the kind="wait" split.
             t_acc = time.monotonic()
             wire.enable_nodelay(conn)
+            try:
+                # A peer that vanishes mid-call (power loss, NAT evict)
+                # must eventually surface as a reset on long blocking
+                # handlers, not hold the conn slot forever.
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            except OSError:
+                pass
             if (self._conn_slots is not None
                     and not self._conn_slots.acquire(blocking=False)):
                 # At the cap: refuse with a diagnosable error rather than
@@ -131,24 +164,61 @@ class EngineServer:
 
     def _serve_slot(self, conn: socket.socket,
                     t_acc: Optional[float] = None) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
         try:
             self._serve_conn(conn, t_acc)
         finally:
+            with self._inflight_lock:
+                self._inflight -= 1
             if self._conn_slots is not None:
                 self._conn_slots.release()
 
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def wait_drained(self, deadline_s: float) -> int:
+        """Block until every in-flight handler finished or the deadline
+        passed; returns the handlers still running (0 = fully drained)."""
+        t_end = time.monotonic() + max(0.0, deadline_s)
+        while time.monotonic() < t_end:
+            if self.inflight() == 0:
+                return 0
+            time.sleep(0.05)
+        return self.inflight()
+
     def _serve_conn(self, conn: socket.socket,
                     t_acc: Optional[float] = None) -> None:
+        # The fd closes on EVERY exit path (the final close below is the
+        # guarantee) and transport failures are attributed by method and
+        # kind — a bare `pass` would leave resets indistinguishable from
+        # idle-client timeouts in a post-mortem.
+        label = "unknown"
         try:
-            with conn:
+            try:
                 if self._header_timeout > 0:
                     conn.settimeout(self._header_timeout)
                 header, world = recv_msg(conn)
+                label = obs.method_label(str(header.get("method")))
                 conn.settimeout(None)  # dispatch may compute for hours
                 self._dispatch(conn, header, world, t_acc)
-        except (ConnectionError, OSError, ValueError):
-            # includes socket.timeout (OSError): idle client shed
-            pass
+            except wire.WireProtocolError:
+                obs.RPC_ERRORS.labels(method=label, kind="protocol").inc()
+            except (socket.timeout, TimeoutError):
+                obs.RPC_ERRORS.labels(method=label, kind="timeout").inc()
+            except (ConnectionError, OSError):
+                obs.RPC_ERRORS.labels(method=label, kind="reset").inc()
+            except ValueError:
+                obs.RPC_ERRORS.labels(method=label, kind="protocol").inc()
+            except Exception as e:
+                # A handler bug must not leak the fd or die silently.
+                obs_exception("server.handler_crashed", e, method=label)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _dispatch(
         self, conn: socket.socket, header: dict, world,
@@ -189,7 +259,61 @@ class EngineServer:
         use. Old clients ignore the extra key. The advert is memoized
         in the wire layer (PR 6) — no env read or sort per reply."""
         header.setdefault("caps", wire.advertised_caps())
+        # Record BEFORE the send: once the handler produced a reply the
+        # operation is committed, and a retry after a lost reply must
+        # replay this outcome, not re-execute it. Mutating replies are
+        # header-only (no board frames), so the record is complete.
+        key = getattr(self._dedupe_ctx, "key", None)
+        if key is not None:
+            self._dedupe_ctx.key = None
+            self._record_reply(key, dict(header))
         send_msg(conn, header, world, frame=frame)
+
+    def _record_reply(self, key: str, reply: dict) -> None:
+        with self._dedupe_lock:
+            ent = self._dedupe.get(key)
+            if ent is not None:
+                ent["reply"] = reply
+                ent["done"].set()
+
+    def _dedupe_check(self, conn, method, label: str, header: dict):
+        """Returns True when this request was answered from the dedupe
+        window (a retry of a request already executed or executing);
+        False when the caller should execute it — in which case the
+        thread-local ctx key is armed for _reply to record the outcome."""
+        req_id = header.get("req_id")
+        if (method not in self.MUTATING_METHODS
+                or not isinstance(req_id, str)
+                or not 0 < len(req_id) <= 64):
+            return False
+        key = f"{method}|{req_id}"
+        with self._dedupe_lock:
+            ent = self._dedupe.get(key)
+            if ent is None:
+                self._dedupe[key] = {"done": threading.Event(),
+                                     "reply": None}
+                while len(self._dedupe) > self.DEDUPE_MAX:
+                    # Window, not ledger: oldest entries age out (a
+                    # retry only needs to survive the client's bounded
+                    # backoff, not forever). dicts iterate in insertion
+                    # order.
+                    del self._dedupe[next(iter(self._dedupe))]
+        if ent is None:
+            self._dedupe_ctx.key = key
+            return False
+        # Duplicate: the first attempt owns execution; wait for its
+        # recorded reply (it may still be running a long Checkpoint).
+        obs.SERVER_DEDUP_HITS.labels(method=label).inc()
+        ent["done"].wait(self.DEDUPE_WAIT_S)
+        reply = ent["reply"]
+        if reply is None:
+            self._reply(conn, {
+                "ok": False,
+                "error": "RuntimeError: duplicate request still "
+                         "executing"})
+        else:
+            self._reply(conn, dict(reply))
+        return True
 
     def _board_frame(self, out, caps, eng=None):
         """Codec-frame a host pixel board under the peer's negotiated
@@ -207,15 +331,10 @@ class EngineServer:
         engine's diffability contract, and the client's declared basis
         all line up; then remember `out` as the viewer's new basis."""
         eng = eng if eng is not None else self.engine
-        vkey = header.get("vkey")
+        vkey = self._view_cache_key(header)
         use_cache = (wire.CAP_XRLE in caps
                      and getattr(eng, "frames_diffable", False)
-                     and isinstance(vkey, str) and 0 < len(vkey) <= 64)
-        if use_cache and header.get("run_id"):
-            # Per-run basis namespace: the same viewer key watching two
-            # fleet runs must not delta one run's frame against the
-            # other's.
-            vkey = f"{header['run_id']}|{vkey}"
+                     and vkey is not None)
         basis = basis_turn = None
         if use_cache:
             want = header.get("basis_turn")
@@ -233,6 +352,29 @@ class EngineServer:
                 while len(self._view_cache) > self.VIEW_CACHE_MAX:
                     self._view_cache.pop(next(iter(self._view_cache)))
         return frame
+
+    def _view_cache_key(self, header: dict):
+        """The per-viewer basis-cache key for a GetView request (the
+        client vkey, namespaced per fleet run: the same viewer key
+        watching two runs must not delta one run's frame against the
+        other's), or None when the request names no usable viewer."""
+        vkey = header.get("vkey")
+        if not (isinstance(vkey, str) and 0 < len(vkey) <= 64):
+            return None
+        if header.get("run_id"):
+            return f"{header['run_id']}|{vkey}"
+        return vkey
+
+    def _drop_view_basis(self, header: dict) -> None:
+        """Invalidate a viewer's basis-cache entry after a reply failed
+        mid-send: the viewer never received the frame we just recorded
+        as its basis, so the next poll must get a fresh keyframe — and
+        a truncated frame must not poison the namespace for a
+        reconnecting viewer."""
+        vkey = self._view_cache_key(header)
+        if vkey is not None:
+            with self._view_cache_lock:
+                self._view_cache.pop(vkey, None)
 
     # Methods that act on ONE run and therefore honour a `run_id`
     # header: the engine's resolve_run maps it to a per-run surface
@@ -265,6 +407,8 @@ class EngineServer:
         # without re-reading the environment or the peer header.
         enc = wire.ConnectionEncoder(header)
         caps = enc.caps
+        if self._dedupe_check(conn, method, label, header):
+            return
         try:
             eng = self._resolve_target(method, header)
             if method == "ServerDistributor":
@@ -315,10 +459,14 @@ class EngineServer:
                     eng.subscribe_view(vkey)
                 out, turn, (fy, fx) = eng.get_view(
                     int(header.get("max_cells", 0)))
-                self._reply(conn, {"ok": True, "turn": turn,
-                                   "fy": fy, "fx": fx},
-                            frame=self._encode_view(header, caps, out,
-                                                    turn, fy, fx, eng))
+                try:
+                    self._reply(conn, {"ok": True, "turn": turn,
+                                       "fy": fy, "fx": fx},
+                                frame=self._encode_view(header, caps, out,
+                                                        turn, fy, fx, eng))
+                except (ConnectionError, OSError):
+                    self._drop_view_basis(header)
+                    raise
             elif method == "GetWindow":
                 # Sparse engines only: live-window pixels + torus origin.
                 out, (ox, oy), turn = eng.get_window()
@@ -596,6 +744,19 @@ def main() -> None:
     from gol_tpu.engine import CKPT_ENV
 
     def _on_term(signo, frame):
+        # Graceful drain: stop accepting first, give in-flight handlers
+        # a bounded window to finish (their replies are the whole point
+        # of draining), THEN checkpoint and exit 0 — so an orderly stop
+        # (systemd, k8s preStop, operator) loses zero turns AND zero
+        # in-flight replies that could have completed.
+        t_drain = time.monotonic()
+        n0 = srv.inflight()
+        deadline = env_float(DRAIN_DEADLINE_ENV, DRAIN_DEADLINE_DEFAULT)
+        obs.SERVER_DRAIN_INFLIGHT.set(n0)
+        obs_log("server.drain_begin", level="warning", inflight=n0,
+                deadline_s=deadline)
+        srv.shutdown()
+        left = srv.wait_drained(deadline)
         ckpt_dir = os.environ.get(CKPT_ENV, "")
         if ckpt_dir:
             # Durable manifest checkpoint first (verified, retained,
@@ -606,6 +767,16 @@ def main() -> None:
                 obs_log("server.sigterm_checkpoint", turn=turn, path=path)
             except Exception as e:
                 obs_exception("server.sigterm_checkpoint_failed", e)
+            # Fleet residents: every non-legacy run gets its own per-run
+            # manifest so a replacement server can restore the whole
+            # fleet, not just the legacy run.
+            ckpt_fleet = getattr(srv.engine, "checkpoint_fleet", None)
+            if ckpt_fleet is not None:
+                try:
+                    n = ckpt_fleet(trigger="sigterm")
+                    obs_log("server.sigterm_fleet_checkpoint", runs=n)
+                except Exception as e:
+                    obs_exception("server.sigterm_checkpoint_failed", e)
             try:
                 # stats() gives (board geometry, turn) without the full
                 # board transfer get_world() would cost.
@@ -617,6 +788,11 @@ def main() -> None:
                     srv.engine.save_checkpoint(path)
             except Exception as e:
                 obs_exception("server.sigterm_checkpoint_failed", e)
+        dur = time.monotonic() - t_drain
+        obs.SERVER_DRAIN_SECONDS.set(dur)
+        # The drain flight event lands BEFORE the dump below records it.
+        obs_log("server.drain", level="warning", inflight_start=n0,
+                inflight_left=left, duration_s=round(dur, 3))
         # After the checkpoint (the dump should record its log event,
         # and a slow checkpoint must not delay the black box by dying
         # first — dump is sub-ms either way).

@@ -111,6 +111,13 @@ from gol_tpu.utils.envcfg import env_int
 _LEN = struct.Struct(">I")
 _XRLE_TOKEN = struct.Struct("<II")
 MAX_HEADER = 1 << 20
+
+
+def _chaos_enabled() -> bool:
+    """One env lookup on the hot path; the chaos module (and its RNG
+    state) is only imported/built when GOL_CHAOS is actually set."""
+    return bool(os.environ.get("GOL_CHAOS"))
+
 # Upper bound on h*w accepted from a peer before allocating: 2^35 cells
 # covers the largest board the framework demonstrates (131072² = 2^34)
 # with one doubling of headroom — a hostile or garbage header must not
@@ -610,6 +617,9 @@ def send_msg(
         payload = memoryview(np.ascontiguousarray(world)).cast("B")
     raw = json.dumps(header).encode()
     head = memoryview(_LEN.pack(len(raw)) + raw)
+    if _chaos_enabled():
+        from gol_tpu import chaos
+        head = memoryview(chaos.send_hook(sock, bytes(head)))
     sent = 0
     try:
         # send() loops instead of sendall() so a connection that dies
@@ -721,6 +731,9 @@ def recv_msg(sock: socket.socket,
     `xrle_basis` = (basis_turn, previous frame ndarray) authorizes xrle
     decoding — only the call sites that kept their previous frame (the
     live-view client) pass it; an unsolicited delta is a protocol error."""
+    if _chaos_enabled():
+        from gol_tpu import chaos
+        chaos.recv_hook(sock)
     tally = _Tally()
     try:
         (n,) = _LEN.unpack(_recv_exact(sock, 4, tally))

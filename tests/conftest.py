@@ -51,6 +51,14 @@ def pytest_configure(config):
         "markers",
         "timeout(seconds): per-test wall-clock limit (SIGALRM; "
         "default GOL_TEST_TIMEOUT or 180 s)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection test (seeded GOL_CHAOS); the long "
+        "sweeps are additionally marked slow")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test, excluded from the tier-1 run "
+        "(-m 'not slow')")
 
 
 def _timeout_limit(item) -> float:

@@ -1,0 +1,234 @@
+"""chaos-smoke: prove the self-healing serving tier end to end on CPU.
+
+Three acceptance gates (PR 10), real processes where the failure is a
+process-level event:
+
+  1. SIGTERM graceful drain — a real --fleet server with residents
+     driving is SIGTERMed: it must exit 0 (drain, not crash) and leave
+     a durable per-run manifest checkpoint for EVERY fleet resident
+     (ck/run-<id>/), not just the legacy run;
+  2. SIGKILL → restart quarantines nothing — a hard-killed fleet
+     server's replacement serves a fresh run to completion with the
+     fleet summary reporting zero quarantined runs: crash recovery is
+     resume, never a false-positive fault verdict;
+  3. poison → quarantine exactly once → auto-restore — in-process
+     FleetEngine under GOL_CHAOS poison=<run>@<turn>: the fabricated
+     device fault must quarantine the run EXACTLY once
+     (gol_runs_quarantined_total{reason="popcount"} +1), auto-restore
+     it from its own per-run checkpoint, and finish bit-identical to
+     an uninjected run of the same seed.
+
+Exit 0 = pass.
+
+    make chaos-smoke    # bench.py --chaos + gate, then this
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def fail(msg: str) -> int:
+    print(f"chaos-smoke: FAIL — {msg}", flush=True)
+    return 1
+
+
+def _wait_turn(cli, run_id: str, turn: int, timeout: float = 90.0):
+    """Poll ListRuns until `run_id` reaches `turn`; its final record."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        runs, summary = cli.list_runs()
+        rec = next((r for r in runs if r["run_id"] == run_id), None)
+        if rec is not None and rec["turn"] >= turn:
+            return rec, summary
+        time.sleep(0.1)
+    return None, {}
+
+
+def gate_drain(tmpdir: str) -> int:
+    """Gate 1: SIGTERM drains — exit 0 + a durable manifest per run."""
+    from gol_tpu.ckpt import manifest as mf
+    from gol_tpu.client import RemoteEngine
+    from tests.server_harness import spawn_server, wait_port
+
+    ckdir = os.path.join(tmpdir, "ck_drain")
+    proc = spawn_server(
+        0, tmpdir, extra_args=("--fleet", "--checkpoint", ckdir,
+                               "--ckpt-every", "4"))
+    try:
+        port = wait_port(proc)
+        if not port:
+            return fail("drain server never announced its port")
+        cli = RemoteEngine(f"127.0.0.1:{port}", timeout=30.0)
+        rng = np.random.default_rng(3)
+        ids = []
+        for i in range(2):
+            board = (rng.random((64, 64)) < 0.3).astype(np.uint8)
+            rec = cli.create_run(64, 64, board=board,
+                                 run_id=f"drain{i}", ckpt_every=4,
+                                 target_turn=10 ** 8)
+            ids.append(rec["run_id"])
+        for rid in ids:
+            rec, _ = _wait_turn(cli, rid, 8)
+            if rec is None:
+                return fail(f"run {rid} never progressed")
+        os.kill(proc.pid, signal.SIGTERM)
+        try:
+            rc = proc.wait(60)
+        except Exception:
+            return fail("SIGTERMed server did not exit")
+        if rc != 0:
+            return fail(f"drain exit code {rc}, want 0")
+        for rid in ids:
+            latest = mf.latest_checkpoint(os.path.join(ckdir,
+                                                       f"run-{rid}"))
+            if latest is None:
+                return fail(f"no per-run drain checkpoint for {rid}")
+            mf.verify_manifest(latest[1])
+        print(f"chaos-smoke: SIGTERM drained, exit 0, per-run "
+              f"checkpoints verified for {ids}", flush=True)
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(10)
+
+
+def gate_restart(tmpdir: str) -> int:
+    """Gate 2: SIGKILL → replacement serves cleanly, quarantines 0."""
+    from gol_tpu.client import RemoteEngine
+    from tests.server_harness import spawn_server, wait_port
+
+    ckdir = os.path.join(tmpdir, "ck_kill")
+    proc1 = spawn_server(
+        0, tmpdir, extra_args=("--fleet", "--checkpoint", ckdir,
+                               "--ckpt-every", "4"))
+    proc2 = None
+    try:
+        port = wait_port(proc1)
+        if not port:
+            return fail("kill server never announced its port")
+        cli = RemoteEngine(f"127.0.0.1:{port}", timeout=30.0)
+        cli.create_run(64, 64, run_id="victim", ckpt_every=4,
+                       target_turn=10 ** 8)
+        if _wait_turn(cli, "victim", 8)[0] is None:
+            return fail("victim never progressed before SIGKILL")
+        os.kill(proc1.pid, signal.SIGKILL)
+        proc1.wait(10)
+
+        proc2 = spawn_server(
+            0, tmpdir, extra_args=("--fleet", "--checkpoint", ckdir))
+        port2 = wait_port(proc2)
+        if not port2:
+            return fail("replacement server never announced its port")
+        cli2 = RemoteEngine(f"127.0.0.1:{port2}", timeout=30.0)
+        rng = np.random.default_rng(5)
+        board = (rng.random((64, 64)) < 0.3).astype(np.uint8)
+        cli2.create_run(64, 64, board=board, run_id="after",
+                        target_turn=32)
+        rec, summary = _wait_turn(cli2, "after", 32)
+        if rec is None:
+            return fail("post-restart run never reached its target")
+        if summary.get("quarantined", 0) != 0:
+            return fail(f"restart quarantined runs: {summary}")
+        print("chaos-smoke: SIGKILL→restart served a run to "
+              "completion, quarantined=0", flush=True)
+        return 0
+    finally:
+        for p in (proc1, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(10)
+
+
+def gate_quarantine(tmpdir: str) -> int:
+    """Gate 3: poisoned run quarantined exactly once, auto-restored
+    from its per-run checkpoint, bit-identical to the clean run."""
+    os.environ["GOL_CKPT"] = os.path.join(tmpdir, "ck_poison")
+    from gol_tpu.fleet.engine import FleetEngine
+    from gol_tpu.obs import catalog as obs
+
+    rng = np.random.default_rng(0)
+    board = (rng.random((64, 64)) < 0.3).astype(np.uint8)
+    eng = FleetEngine(bucket_sizes=(64,), chunk_turns=4, slot_base=4)
+    try:
+        eng.create_run(64, 64, board=board.copy(), run_id="clean",
+                       ckpt_every=8, target_turn=40)
+        hc = eng._runs["clean"]
+        if not hc.done.wait(60):
+            return fail("clean fleet run did not finish")
+        clean_board, clean_turn = eng._run_board(hc)
+
+        q0 = obs.RUNS_QUARANTINED.labels(reason="popcount").value
+        r0 = obs.RUNS_QUARANTINE_RESTORES.labels(status="ok").value
+        os.environ["GOL_CHAOS"] = "poison=victim@20,seed=1"
+        try:
+            eng.create_run(64, 64, board=board.copy(), run_id="victim",
+                           ckpt_every=8, target_turn=40)
+            hv = eng._runs["victim"]
+            if not hv.done.wait(60):
+                return fail(f"poisoned run did not finish "
+                            f"(state={hv.state})")
+        finally:
+            os.environ.pop("GOL_CHAOS", None)
+        vb, vt = eng._run_board(hv)
+
+        if vt != clean_turn:
+            return fail(f"restored run at turn {vt}, clean at "
+                        f"{clean_turn}")
+        if not np.array_equal(vb, clean_board):
+            return fail("restored run diverged from the clean run")
+        dq = obs.RUNS_QUARANTINED.labels(reason="popcount").value - q0
+        dr = obs.RUNS_QUARANTINE_RESTORES.labels(status="ok").value - r0
+        if dq != 1:
+            return fail(f"quarantined {dq} times, want exactly 1")
+        if dr != 1:
+            return fail(f"restored {dr} times, want exactly 1")
+        if hv.describe().get("quarantine_reason") != "popcount":
+            return fail(f"describe lacks the quarantine record: "
+                        f"{hv.describe()}")
+        if eng.runs_summary().get("quarantined", 0) != 0:
+            return fail("a recovered run still counts as quarantined")
+        print(f"chaos-smoke: poisoned run quarantined exactly once, "
+              f"auto-restored (tries={hv.quarantine_tries}), "
+              f"bit-identical at turn {vt}", flush=True)
+        return 0
+    finally:
+        for rid in ("clean", "victim"):
+            try:
+                eng.destroy_run(rid)
+            except Exception:
+                pass
+        eng.kill_prog()
+        os.environ.pop("GOL_CKPT", None)
+
+
+def main() -> int:
+    tmpdir = tempfile.mkdtemp(prefix="gol_chaos_smoke_")
+    rc = gate_drain(tmpdir)
+    rc = rc or gate_restart(tmpdir)
+    rc = rc or gate_quarantine(tmpdir)
+    if rc == 0:
+        print("chaos-smoke: PASS", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    rc = main()
+    # os._exit dodges the known XLA daemon-thread teardown abort (the
+    # in-process FleetEngine's loop/writer threads at interpreter
+    # exit); every gate already flushed its verdict.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

@@ -62,7 +62,8 @@ DEFAULT_NOISE_FLOOR = 5.0
 DEFAULT_MAX_REGRESSION = 10.0
 DEFAULT_GATE_PATTERN = (
     r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us"
-    r"|rpc p\d+ ms|efficiency_pct|overlap_pct")
+    r"|rpc p\d+ ms|efficiency_pct|overlap_pct"
+    r"|availability_pct|retries_per_call")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
@@ -157,6 +158,13 @@ def _higher_is_better(metric: str, unit: Optional[str]) -> bool:
     low0 = metric.lower()
     if "_efficiency_pct" in low0 or "_overlap_pct" in low0:
         return True
+    # Chaos availability legs: availability is a FLOOR (higher is
+    # better), retry spend is a CEILING (lower is better) — both are
+    # unitless-ish quantities none of the later heuristics classify.
+    if "availability" in low0:
+        return True
+    if "retries" in low0:
+        return False
     if unit and (unit.endswith("/s") or unit.endswith("/sec")):
         return True
     if "/sec" in metric or "/s " in metric or "cups" in metric.lower():
