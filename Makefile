@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke chaos-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -85,8 +85,19 @@ chaos-smoke:
 mesh-smoke:
 	JAX_PLATFORMS=cpu python tools/mesh_smoke.py
 
+# Mesh-sharded fleet check, CPU-only with 8 forced host devices: a
+# reduced bench.py --fleet --mesh matrix (1-way baseline + one 4-way
+# batch-sharded leg, 64 resident 512² runs) in-process — the 4-way
+# board must be bit-identical to the 1-device fleet, zero new step
+# signatures inside the window, the placement mesh stamped in detail
+# and in gol_fleet_mesh_devices / gol_fleet_device_resident_runs, and
+# the per-device cups + fleet_scaling_efficiency_pct floors gate via
+# BASELINE.json (tools/fleet_mesh_smoke.py).
+fleet-mesh-smoke:
+	JAX_PLATFORMS=cpu python tools/fleet_mesh_smoke.py
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke chaos-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -1156,7 +1156,8 @@ def bench_fleet(run_counts=FLEET_RUN_COUNTS, n: int = 512,
 
     for var in ("GOL_CKPT", "GOL_CKPT_EVERY_TURNS", "GOL_RULE",
                 "GOL_FLEET_BUCKETS", "GOL_FLEET_CHUNK",
-                "GOL_FLEET_SLOT_BASE", "GOL_FLEET_MEM_BUDGET"):
+                "GOL_FLEET_SLOT_BASE", "GOL_FLEET_MEM_BUDGET",
+                "GOL_FLEET_MESH_DEVICES", "GOL_FLEET_MIN_SLOTS_PER_DEV"):
         os.environ.pop(var, None)
     rc = 0
     run_counts = tuple(sorted(run_counts))
@@ -1216,6 +1217,10 @@ def bench_fleet(run_counts=FLEET_RUN_COUNTS, n: int = 512,
             parity = bool(np.array_equal(
                 board, _fleet_expected(seed0, turn)))
             overhead = c1["chunk_overhead_us"]
+            # The PLACEMENT mesh the leg actually ran on — not
+            # jax.device_count() (an unsharded fleet dispatch runs on
+            # one device no matter how many exist).
+            fleet_stats = eng.stats()["fleet"]
         finally:
             eng.kill_prog()
         turns_ret = c1["board_turns"] - c0["board_turns"]
@@ -1239,6 +1244,10 @@ def bench_fleet(run_counts=FLEET_RUN_COUNTS, n: int = 512,
         agg[count] = cups
         detail = {
             "runs": count, "size": n, "window_s": round(elapsed, 4),
+            "devices": fleet_stats["mesh"]["devices"],
+            "mesh": fleet_stats["mesh"],
+            "placement": (fleet_stats["buckets"][0]["placement"]
+                          if fleet_stats["buckets"] else None),
             "board_turns_retired": int(turns_ret),
             "turns_per_run_per_s": round(
                 turns_ret / count / elapsed, 1),
@@ -1281,6 +1290,192 @@ def bench_fleet(run_counts=FLEET_RUN_COUNTS, n: int = 512,
                   f"{speedup:.1f}x < {FLEET_SPEEDUP_FLOOR:.0f}x "
                   f"acceptance floor", file=sys.stderr)
             rc |= 1
+    return rc
+
+
+# --fleet --mesh leg sizing (PR 11): the mesh-sharded fleet matrix.
+# Each leg holds `count` resident n² runs in ONE FleetEngine whose
+# bucket batches are sharded over the first w devices along the slot
+# axis, measured the same way as --fleet (retirement-counter deltas
+# over a free-running wall window). 1-way is the efficiency baseline;
+# parity is a fixed-turn run compared bit-identical against the
+# 1-device fleet's board.
+FLEET_MESH_WAYS = (1, 2, 4, 8)
+FLEET_MESH_RUN_COUNTS = (64, 512)
+FLEET_MESH_WINDOW_S = 2.0
+FLEET_MESH_PARITY_TURNS = 64
+
+
+def bench_fleet_mesh(ways=FLEET_MESH_WAYS,
+                     run_counts=FLEET_MESH_RUN_COUNTS, n: int = 512,
+                     window_s: float = FLEET_MESH_WINDOW_S) -> int:
+    """Multi-device fleet scaling legs (`--fleet --mesh`): for each
+    (run count, mesh width) cell, `count` resident n² runs free-run in
+    a FleetEngine placed over the first w devices (batch-axis bucket
+    sharding — zero collectives; the policy falls back to spatial
+    sharding only for big-board/low-occupancy classes, which these
+    legs never hit). Emits per leg:
+
+    * aggregate cell-updates/sec — same counters as --fleet
+    * per-device cell-updates/sec — aggregate / w (the BASELINE-gated
+      floor: honest per-chip throughput, not inflated by width)
+    * fleet_scaling_efficiency_pct (w>1) — 100·cups_w/(w·cups_1),
+      gated higher-is-better
+
+    Gates, each hard-failing the leg:
+    * parity — a fixed-turn run's board must be BIT-IDENTICAL to the
+      1-device fleet's (and the 1-way board to a device torus replay)
+    * zero new step signatures inside the measurement window (admits
+      into existing sharded capacity compile nothing)
+    """
+    import os
+
+    from gol_tpu.fleet import FleetEngine
+    from gol_tpu.obs import devstats
+
+    for var in ("GOL_CKPT", "GOL_CKPT_EVERY_TURNS", "GOL_RULE",
+                "GOL_FLEET_BUCKETS", "GOL_FLEET_CHUNK",
+                "GOL_FLEET_SLOT_BASE", "GOL_FLEET_MEM_BUDGET",
+                "GOL_FLEET_MESH_DEVICES", "GOL_FLEET_MIN_SLOTS_PER_DEV"):
+        os.environ.pop(var, None)
+    import jax
+
+    devs = list(jax.devices())
+    ways = tuple(sorted(set(int(w) for w in ways) | {1}))
+    usable = tuple(w for w in ways if w <= len(devs))
+    skipped = tuple(w for w in ways if w > len(devs))
+    if skipped:
+        print(f"note: skipping mesh widths {skipped}: only "
+              f"{len(devs)} devices visible", file=sys.stderr)
+    rc = 0
+    rng = np.random.default_rng(11)
+    for count in tuple(sorted(run_counts)):
+        seeds = [(rng.random((n, n)) < 0.25).astype(np.uint8)
+                 for _ in range(count)]
+        base_cups = None
+        base_parity = None
+        for w in usable:
+            eng = FleetEngine(bucket_sizes=(n,),
+                              slot_base=max(8, count),
+                              devices=devs[:w])
+            try:
+                # Fixed-turn parity run first: parks at PARITY_TURNS,
+                # its frozen board is the cross-fleet comparison point.
+                eng.create_run(n, n, board=seeds[0].copy(),
+                               run_id="parity",
+                               target_turn=FLEET_MESH_PARITY_TURNS,
+                               wait=False)
+                for i, seed in enumerate(seeds):
+                    eng.create_run(n, n, board=seed, run_id=f"b{i}",
+                                   wait=False)
+                deadline = time.monotonic() + 180
+                while True:
+                    s = eng.runs_summary()
+                    if s["resident"] + s["parked"] >= count + 1:
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"fleet-mesh {w}-way placement timed out")
+                    time.sleep(0.05)
+                while (eng.resolve_run("parity").describe_run()["state"]
+                       != "parked"):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"fleet-mesh {w}-way parity run never "
+                            f"reached its target")
+                    time.sleep(0.05)
+                pboard, pturn = eng.resolve_run("parity").get_world()
+                if w == 1:
+                    base_parity = pboard
+                    parity = bool(np.array_equal(
+                        pboard, _fleet_expected(
+                            seeds[0], FLEET_MESH_PARITY_TURNS)))
+                    parity_how = (f"{FLEET_MESH_PARITY_TURNS}-turn "
+                                  f"board vs device torus replay, "
+                                  f"bit-identical")
+                else:
+                    parity = bool(np.array_equal(pboard, base_parity))
+                    parity_how = (f"{FLEET_MESH_PARITY_TURNS}-turn "
+                                  f"board vs the 1-device fleet, "
+                                  f"bit-identical")
+                eng.destroy_run("parity")  # keep the window clean
+                warm0 = eng.throughput_counters()["board_turns"]
+                while eng.throughput_counters()["board_turns"] == warm0:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"fleet-mesh {w}-way loop never dispatched")
+                    time.sleep(0.05)
+                sig0 = devstats.signature_count()
+                eng.reset_bench_window()
+                c0 = eng.throughput_counters()
+                t0 = time.perf_counter()
+                time.sleep(window_s)
+                c1 = eng.throughput_counters()
+                elapsed = time.perf_counter() - t0
+                new_sigs = devstats.signature_count() - sig0
+                p50, p99 = eng.latency_percentiles()
+                fleet_stats = eng.stats()["fleet"]
+            except Exception as e:
+                print(f"BENCH LEG FAILED (fleet-mesh {w}-way, {count} "
+                      f"runs): {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                rc |= 1
+                continue  # finally still kills the engine
+            finally:
+                eng.kill_prog()
+            turns_ret = c1["board_turns"] - c0["board_turns"]
+            cells_ret = c1["cell_updates"] - c0["cell_updates"]
+            if turns_ret <= 0 or elapsed <= 0:
+                print(f"BENCH LEG FAILED (fleet-mesh {w}-way, {count} "
+                      f"runs): nothing retired", file=sys.stderr)
+                rc |= 1
+                continue
+            if not parity:
+                print(f"PARITY FAIL (fleet-mesh {w}-way, {count} x "
+                      f"{n}x{n}): {parity_how}", file=sys.stderr)
+                rc |= 1
+            if new_sigs:
+                print(f"BENCH LEG FAILED (fleet-mesh {w}-way, {count} "
+                      f"runs): {new_sigs} new step signature(s) inside "
+                      f"the measurement window — a steady-state fleet "
+                      f"must compile nothing", file=sys.stderr)
+                rc |= 1
+            cups = cells_ret / elapsed
+            detail = {
+                "runs": count, "size": n, "ways": w,
+                "devices": fleet_stats["mesh"]["devices"],
+                "mesh": fleet_stats["mesh"],
+                "placement": (fleet_stats["buckets"][0]["placement"]
+                              if fleet_stats["buckets"] else None),
+                "window_s": round(elapsed, 4),
+                "board_turns_retired": int(turns_ret),
+                "turns_per_run_per_s": round(
+                    turns_ret / count / elapsed, 1),
+                "chunk_turns": eng.chunk_turns,
+                "p50_turn_latency_ms": round(p50 * 1e3, 3),
+                "p99_turn_latency_ms": round(p99 * 1e3, 3),
+                "new_step_signatures_in_window": int(new_sigs),
+                "alive_parity": parity,
+                "parity_check": parity_how,
+                "method": "retirement-counter deltas over a "
+                          "free-running wall window; every counted "
+                          "turn fully synced",
+            }
+            _emit(f"aggregate cell-updates/sec (fleet-mesh, {w}-way, "
+                  f"{count} x {n}x{n} runs)", round(cups, 1),
+                  "cell-updates/s", None, detail)
+            _emit(f"per-device cell-updates/sec (fleet-mesh, {w}-way, "
+                  f"{count} x {n}x{n} runs)", round(cups / w, 1),
+                  "cell-updates/s", None, detail)
+            if w == 1:
+                base_cups = cups
+            elif base_cups:
+                eff = 100.0 * cups / (w * base_cups)
+                _emit(f"fleet_scaling_efficiency_pct ({w}-way, {count} "
+                      f"x {n}x{n} runs)", round(eff, 1), "%", None,
+                      {**detail,
+                       "baseline_1way_cups": round(base_cups, 1),
+                       "aggregate_cups": round(cups, 1)})
     return rc
 
 
@@ -1603,7 +1798,9 @@ def main() -> int:
                          "the gated scaling_efficiency_pct / "
                          "halo_overlap_pct lines; forces 8 host "
                          "devices unless XLA_FLAGS already pins a "
-                         "count")
+                         "count. With --fleet: the mesh-sharded "
+                         "fleet matrix instead (gated "
+                         "fleet_scaling_efficiency_pct)")
     ap.add_argument("--mesh-ways", default="", metavar="W[,W...]",
                     help="with --mesh: comma-separated mesh widths "
                          "(default 2,4,8; widths beyond the device "
@@ -1701,13 +1898,48 @@ def main() -> int:
 
 
 def _dispatch(args, ap) -> int:
+    if args.mesh and args.fleet:
+        # The mesh-sharded fleet matrix (PR 11): run-count x mesh-width
+        # legs of batched bucket dispatch sharded over the device mesh.
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.load or args.chaos:
+            ap.error("--fleet --mesh is its own config; combine only "
+                     "with --size/--fleet-runs/--fleet-window/"
+                     "--mesh-ways")
+        if args.mesh_ways:
+            try:
+                ways = tuple(int(x) for x in
+                             args.mesh_ways.split(",") if x.strip())
+            except ValueError:
+                ap.error("--mesh-ways wants comma-separated integers")
+            if not ways or min(ways) < 1:
+                ap.error("--mesh-ways wants mesh widths >= 1")
+        else:
+            ways = FLEET_MESH_WAYS
+        if args.fleet_runs:
+            try:
+                counts = tuple(int(x) for x in
+                               args.fleet_runs.split(",") if x.strip())
+            except ValueError:
+                ap.error("--fleet-runs wants comma-separated integers")
+            if not counts or min(counts) < 1:
+                ap.error("--fleet-runs wants positive run counts")
+        else:
+            counts = FLEET_MESH_RUN_COUNTS
+        return bench_fleet_mesh(
+            ways=ways, run_counts=counts,
+            n=args.size if args.size is not None else 512,
+            window_s=(args.fleet_window if args.fleet_window
+                      else FLEET_MESH_WINDOW_S))
     if args.mesh:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
-                or args.fleet or args.load or args.chaos \
+                or args.load or args.chaos \
                 or args.size is not None:
             ap.error("--mesh is its own config; combine only with "
-                     "--mesh-ways/--turns")
+                     "--mesh-ways/--turns (or --fleet for the "
+                     "mesh-sharded fleet matrix)")
         if args.mesh_ways:
             try:
                 ways = tuple(int(x) for x in

@@ -45,7 +45,11 @@ from gol_tpu.ckpt.manifest import (  # noqa: F401
 )
 from gol_tpu.ckpt.restore import resolve, restore_engine  # noqa: F401
 from gol_tpu.ckpt.retention import RetentionPolicy  # noqa: F401
-from gol_tpu.ckpt.writer import CheckpointWriter, Snapshot  # noqa: F401
+from gol_tpu.ckpt.writer import (  # noqa: F401
+    CheckpointWriter,
+    CheckpointWriterPool,
+    Snapshot,
+)
 
 # Env names (single source; engine/server/main/bench all import these).
 CKPT_DIR_ENV = "GOL_CKPT"

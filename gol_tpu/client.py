@@ -65,8 +65,8 @@ METHOD_RETRY_BUDGETS = {"Ping": 0, "KillProg": 0}
 # req_id header (stable across retries) so the server's dedupe window
 # makes the retry idempotent. Read-only methods are naturally safe.
 MUTATING_METHODS = frozenset({
-    "CreateRun", "DestroyRun", "Checkpoint", "CFput", "DrainFlags",
-    "RestoreRun", "AbortRun", "Profile", "KillProg",
+    "CreateRun", "DestroyRun", "SetRule", "Checkpoint", "CFput",
+    "DrainFlags", "RestoreRun", "AbortRun", "Profile", "KillProg",
 })
 
 
@@ -508,6 +508,18 @@ class RemoteEngine:
         (FleetUnsupported)."""
         resp, _ = self._call({"method": "DestroyRun",
                               "run_id": str(run_id)},
+                             timeout=self._timeout)
+        return dict(resp["run"])
+
+    def set_rule(self, run_id: str, rule: str) -> dict:
+        """Migrate a fleet run to a new life-like rule without dropping
+        its board (evict -> readmit through the placement queue).
+        Returns the run's describe() record — state "queued" until the
+        fleet loop re-places it. Raises on unknown ids, the legacy
+        default run, and non-life-like rules."""
+        resp, _ = self._call({"method": "SetRule",
+                              "run_id": str(run_id),
+                              "rule": str(rule)},
                              timeout=self._timeout)
         return dict(resp["run"])
 

@@ -8,8 +8,8 @@ budget resolves, in order:
     GOL_FLEET_MEM_BUDGET          explicit byte budget (tests, ops)
     devstats.poll_device_memory() GOL_FLEET_MEM_FRACTION (default 0.5)
                                   of the summed per-device limit_bytes
-    DEFAULT_BUDGET_BYTES          256 MiB — backends that report no
-                                  memory stats (CPU hosts)
+    DEFAULT_BUDGET_BYTES          256 MiB × placement devices — backends
+                                  that report no memory stats (CPU hosts)
 
 Each resident run is charged `run_cost(hb, wpb)` = its bucket slot's
 packed bytes × COST_FACTOR (3: resident state + the stepped copy jax
@@ -60,9 +60,17 @@ class AdmissionController:
 
     def __init__(self, budget_bytes: Optional[int] = None,
                  max_runs: Optional[int] = None,
-                 queue_max: Optional[int] = None) -> None:
+                 queue_max: Optional[int] = None,
+                 devices: int = 1) -> None:
         self._lock = threading.Lock()
         self._budget = budget_bytes
+        # Placement-mesh width (PR 11): the DEFAULT budget scales as
+        # devices × per-device bytes — a bucket sharded over 8 devices
+        # holds 1/8th of its batch on each. Explicit budgets (arg or
+        # GOL_FLEET_MEM_BUDGET) stay ABSOLUTE: tests and operators that
+        # pin a byte count mean that byte count; and the probed path
+        # already sums per-device limit_bytes, so it needs no scaling.
+        self.devices = max(1, int(devices))
         self.max_runs = (max_runs if max_runs is not None
                          else env_int(MAX_RUNS_ENV, DEFAULT_MAX_RUNS,
                                       minimum=1))
@@ -86,7 +94,7 @@ class AdmissionController:
         if env_budget:
             self._budget = env_budget
             return self._budget
-        budget = DEFAULT_BUDGET_BYTES
+        budget = DEFAULT_BUDGET_BYTES * self.devices
         try:
             from gol_tpu.obs import devstats
 
@@ -164,4 +172,5 @@ class AdmissionController:
                 "committed_bytes": self.committed_bytes,
                 "budget_bytes": self.budget_bytes(),
                 "max_runs": self.max_runs,
+                "devices": self.devices,
             }

@@ -29,18 +29,35 @@ Batch-shape stability: slot capacity is allocated in powers of two
 capacity reuses the compiled (cap, hb, wpb) program — zero new step
 signatures, the no-recompile-churn witness. Growth retraces once per
 doubling: O(log runs) signatures per bucket over the fleet's lifetime.
+
+Mesh placement (PR 11): on a multi-device engine each bucket is placed
+per `choose_placement` — BATCH-AXIS by default (the slot axis split over
+a 1-D 'slots' mesh; embarrassingly parallel, zero halo traffic, the
+per-slot popcount reduction stays shard-local), falling back to SPATIAL
+row sharding (the `parallel/halo.py` ppermute machinery, batched over
+slots) for big-board classes whose expected occupancy (`slot_base`)
+can't put even one slot on every device. Batch placement keeps slot
+capacity a power of two PER SHARD by flooring cap at the device count
+(both pow2), so admission into free capacity still compiles nothing;
+the device count and placement ride `signature_key`, keeping the
+signature witness honest across placements. A slot gather
+(`read_board`/`slot_words`/`evict`) indexes the slot axis away, so
+checkpoints and quarantine readbacks stay bit-identical to the
+single-device fleet.
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu.fleet.handles import RunHandle, fits_bucket, tile_board
 from gol_tpu.obs import devstats
@@ -51,6 +68,8 @@ from gol_tpu.ops.bitpack import (
     unpack_np,
     words_bytes_np,
 )
+from gol_tpu.parallel.mesh import ROWS_AXIS, batch_sharding
+from gol_tpu.parallel.shmap import shard_map
 
 # Bucket side lengths (square, word-aligned). Overridable per engine
 # (GOL_FLEET_BUCKETS / constructor) — these are the paper-bench classes.
@@ -58,6 +77,42 @@ DEFAULT_BUCKET_SIZES = (512, 1024, 2048)
 
 # Initial slot capacity per bucket; rounded up to a power of two.
 DEFAULT_SLOT_BASE = 8
+
+# Batch-axis placement needs at least this many EXPECTED slots per device
+# (slot_base / devices) to be worth splitting the slot axis — below it the
+# pow2 capacity floor would pad the batch with garbage slots just to feed
+# the mesh. Overridable for policy tests via GOL_FLEET_MIN_SLOTS_PER_DEV.
+DEFAULT_MIN_SLOTS_PER_DEVICE = 1
+
+
+def min_slots_per_device() -> float:
+    raw = os.environ.get("GOL_FLEET_MIN_SLOTS_PER_DEV")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_MIN_SLOTS_PER_DEVICE
+
+
+def choose_placement(hb: int, wb: int, slot_base: int,
+                     devices: int) -> str:
+    """Per-bucket-class placement policy: 'single' | 'batch' | 'spatial'.
+
+    'batch' (slot axis over the mesh) whenever the class's expected
+    occupancy puts >= min_slots_per_device slots on every device —
+    zero-halo-traffic, the near-linear regime. Big-board classes below
+    that occupancy fall back to 'spatial' row sharding (halo exchange
+    per turn) when the board's rows divide the mesh; classes that can
+    do neither (occupancy too low AND rows indivisible — only private
+    odd-shape buckets) keep 'batch', paying the capacity pad."""
+    if devices <= 1:
+        return "single"
+    if slot_base / devices >= min_slots_per_device():
+        return "batch"
+    if hb % devices == 0:
+        return "spatial"
+    return "batch"
 
 
 def choose_bucket_size(h: int, w: int,
@@ -101,6 +156,39 @@ def step_program(rule, turns: int):
     return jax.jit(prog)
 
 
+@functools.lru_cache(maxsize=None)
+def spatial_step_program(rule, turns: int, mesh: Mesh):
+    """The spatial-fallback program for big-board bucket classes: every
+    slot's ROWS split over the mesh, one `shard_map` + ppermute ring halo
+    exchange per turn (`parallel/halo.batched_packed_local_step` — the
+    PR-9 machinery batched over slots), per-slot popcounts as a
+    shard-local partial sum + `lax.psum` sharded reduction riding the
+    same dispatch. Same (words) -> (words', alive) contract as
+    `step_program`."""
+    from gol_tpu.parallel.halo import batched_packed_local_step
+
+    n_shards = mesh.shape[ROWS_AXIS]
+    spec = P(None, ROWS_AXIS, None)
+
+    def prog(words):
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=spec,
+            out_specs=(spec, P(None)),
+        )
+        def run_local(local):
+            def body(p, _):
+                return batched_packed_local_step(p, n_shards, rule), None
+
+            out, _ = lax.scan(body, local, None, length=turns)
+            alive = jnp.sum(lax.population_count(out), axis=(-1, -2),
+                            dtype=jnp.int32)
+            return out, lax.psum(alive, ROWS_AXIS)
+
+        return run_local(words)
+
+    return jax.jit(prog)
+
+
 def board_to_words(board01: np.ndarray) -> np.ndarray:
     """{0,1} (h, w) board -> host packed words (h, ceil(w/32)) '<u4'."""
     return pack_np(board01).view("<u4")
@@ -118,22 +206,54 @@ class Bucket:
     stamping, dispatch all happen there), so no lock lives here."""
 
     def __init__(self, hb: int, wb: int, rule,
-                 slot_base: int = DEFAULT_SLOT_BASE) -> None:
+                 slot_base: int = DEFAULT_SLOT_BASE,
+                 mesh: Optional[Mesh] = None,
+                 placement: str = "single") -> None:
         if wb % WORD_BITS:
             raise ValueError(f"bucket width {wb} not word-aligned")
         self.hb = int(hb)
         self.wb = int(wb)
         self.wpb = self.wb // WORD_BITS
         self.rule = rule
+        # A 1-device mesh is the single-device fleet: no sharding, the
+        # exact PR-7 array/program path (protects the gated single-device
+        # baselines by construction).
+        if mesh is None or int(mesh.size) <= 1:
+            mesh, placement = None, "single"
+        self.mesh = mesh
+        self.placement = placement
+        self.devices = int(mesh.size) if mesh is not None else 1
+        if placement == "batch":
+            self._sharding: Optional[NamedSharding] = batch_sharding(mesh)
+        elif placement == "spatial":
+            if self.hb % self.devices:
+                raise ValueError(
+                    f"spatial bucket: {self.hb} rows not divisible by "
+                    f"{self.devices} devices")
+            self._sharding = NamedSharding(mesh, P(None, ROWS_AXIS, None))
+        else:
+            self._sharding = None
         cap = 1
-        while cap < max(1, slot_base):
+        # Batch placement floors capacity at the device count so every
+        # shard holds a pow2 slot count (cap and devices are both pow2).
+        floor = max(1, slot_base,
+                    self.devices if placement == "batch" else 1)
+        while cap < floor:
             cap *= 2
-        self.words = jnp.zeros((cap, self.hb, self.wpb), dtype=jnp.uint32)
+        self.words = self._shard(
+            jnp.zeros((cap, self.hb, self.wpb), dtype=jnp.uint32))
         self.slots: List[Optional[RunHandle]] = [None] * cap
         self.free: List[int] = list(range(cap - 1, -1, -1))
         # Round-robin bookkeeping the fairness test reads.
         self.dispatches = 0
         self.turns_served = 0
+
+    def _shard(self, arr: jax.Array) -> jax.Array:
+        """Pin `arr` to this bucket's placement (a no-op handle reuse
+        when it already matches, and the identity on a single device)."""
+        if self._sharding is None:
+            return arr
+        return jax.device_put(arr, self._sharding)
 
     # ------------------------------------------------------------ slots
 
@@ -155,8 +275,9 @@ class Bucket:
         """Double capacity, preserving resident slots. One retrace per
         doubling — the bounded, deliberate kind of signature churn."""
         new_cap = self.cap * 2
-        grown = jnp.zeros((new_cap, self.hb, self.wpb), dtype=jnp.uint32)
-        self.words = grown.at[: self.cap].set(self.words)
+        grown = self._shard(
+            jnp.zeros((new_cap, self.hb, self.wpb), dtype=jnp.uint32))
+        self.words = self._shard(grown.at[: self.cap].set(self.words))
         self.free.extend(range(new_cap - 1, self.cap - 1, -1))
         self.slots.extend([None] * self.cap)
 
@@ -176,7 +297,7 @@ class Bucket:
         tiled = tile_board(np.asarray(board01, dtype=np.uint8),
                            self.hb, self.wb)
         host = np.ascontiguousarray(board_to_words(tiled))
-        self.words = self.words.at[slot].set(jnp.asarray(host))
+        self.words = self._shard(self.words.at[slot].set(jnp.asarray(host)))
 
     def read_board(self, slot: int, h: int, w: int) -> np.ndarray:
         """Host {0,1} board of a slot: device readback of the slot's
@@ -215,8 +336,8 @@ class Bucket:
         `self.words` may hold a poisoned or unusable buffer; paused/
         parked residents have authoritative host copies, and faulted
         actives were released before this call."""
-        self.words = jnp.zeros((self.cap, self.hb, self.wpb),
-                               dtype=jnp.uint32)
+        self.words = self._shard(
+            jnp.zeros((self.cap, self.hb, self.wpb), dtype=jnp.uint32))
         for slot, h in enumerate(self.slots):
             if h is not None and h.frozen is not None:
                 self.stamp(slot, h.frozen)
@@ -224,16 +345,32 @@ class Bucket:
     # --------------------------------------------------------- dispatch
 
     def signature_key(self, turns: int) -> tuple:
+        # Placement and device count are part of the compiled-program
+        # identity: jit caches per input sharding, so a 4-way batch
+        # program is a different executable than the 1-device one and
+        # must count as a different signature for the witness to stay
+        # honest.
         return ("fleet", self.cap, self.hb, self.wpb, turns,
-                self.rule.rulestring)
+                self.rule.rulestring, self.placement, self.devices)
 
     def dispatch(self, turns: int):
         """One serving quantum: advance every slot `turns` turns in a
         single device dispatch. Returns the per-slot popcount DEVICE
         array — the caller decides when to sync (that sync is the
-        fleet's device-wait measurement point)."""
+        fleet's device-wait measurement point).
+
+        Batch placement needs no bespoke program: `self.words` carries
+        the slots-axis NamedSharding, and jit (pjit) propagates it
+        through the same `step_program` — the scan stays elementwise on
+        each device's slot block and the popcount reduction is over
+        unsharded trailing axes, so the compiled SPMD program moves zero
+        bytes between devices. Spatial placement dispatches the
+        shard_map halo program instead."""
         devstats.note_signature(self.signature_key(turns))
-        prog = step_program(self.rule, turns)
+        if self.placement == "spatial":
+            prog = spatial_step_program(self.rule, turns, self.mesh)
+        else:
+            prog = step_program(self.rule, turns)
         self.words, alive = prog(self.words)
         self.dispatches += 1
         self.turns_served += turns

@@ -60,6 +60,7 @@ from gol_tpu.fleet.buckets import (
     DEFAULT_SLOT_BASE,
     board_to_words,
     choose_bucket_size,
+    choose_placement,
     private_shape,
     words_to_board,
 )
@@ -79,11 +80,13 @@ from gol_tpu.obs import timeline as obs_timeline
 from gol_tpu.obs.log import exception as obs_exception
 from gol_tpu.obs.log import log as obs_log
 from gol_tpu.ops.bitpack import WORD_BITS, packed_run_turns
+from gol_tpu.parallel.mesh import make_batch_mesh, make_mesh, mesh_geometry
 from gol_tpu.utils.envcfg import env_float, env_int
 
 BUCKETS_ENV = "GOL_FLEET_BUCKETS"     # csv of square class sides
 CHUNK_ENV = "GOL_FLEET_CHUNK"         # serving quantum in turns
 SLOT_BASE_ENV = "GOL_FLEET_SLOT_BASE"  # initial slots per bucket
+MESH_DEVICES_ENV = "GOL_FLEET_MESH_DEVICES"  # placement mesh width
 DEFAULT_CHUNK = 8
 
 METRICS_FLUSH_SECONDS = 0.5  # same batched-flush cadence as engine.py
@@ -141,8 +144,24 @@ class FleetEngine(ControlFlagProtocol):
         import jax
 
         self._rule = rule
-        self._devices = list(devices) if devices is not None \
-            else list(jax.devices())
+        # Placement devices (PR 11): the mesh the fleet actually shards
+        # buckets over — NOT jax.device_count() (an unsharded dispatch
+        # runs on ONE device no matter how many exist; stats and bench
+        # records must stamp the real placement). Explicit `devices`
+        # wins; else GOL_FLEET_MESH_DEVICES takes the first N visible
+        # devices; else the fleet stays single-device.
+        if devices is not None:
+            self._devices = list(devices)
+        else:
+            want = env_int(MESH_DEVICES_ENV, 0, minimum=0)
+            self._devices = list(jax.devices())[: want or 1]
+        if not self._devices:
+            raise ValueError("fleet engine needs at least one device")
+        self._mesh_batch = (make_batch_mesh(devices=self._devices)
+                            if len(self._devices) > 1 else None)
+        if self._mesh_batch is not None:
+            obs_devstats.note_mesh(mesh_geometry(self._mesh_batch))
+        obs.FLEET_MESH_DEVICES.set(len(self._devices))
         if bucket_sizes is not None:
             sizes = tuple(int(s) for s in bucket_sizes)
         else:
@@ -156,7 +175,12 @@ class FleetEngine(ControlFlagProtocol):
             CHUNK_ENV, DEFAULT_CHUNK, minimum=1)
         self.slot_base = int(slot_base) if slot_base else env_int(
             SLOT_BASE_ENV, DEFAULT_SLOT_BASE, minimum=1)
-        self.admission = admission or AdmissionController()
+        self.admission = admission or AdmissionController(
+            devices=len(self._devices))
+        # Shared checkpoint writer pool (PR 11): a bounded worker set
+        # serves every run's cadence checkpoints round-robin instead of
+        # one lazy thread per resident run. Created on first use.
+        self._ckpt_pool = None
 
         # ControlFlagProtocol state (legacy flags stash until run0
         # exists; then flags go straight to the handle's queue).
@@ -328,6 +352,63 @@ class FleetEngine(ControlFlagProtocol):
             self._wake.notify_all()
         obs.RUNS_DESTROYED.inc()
         return rec
+
+    def set_rule(self, run_id: str, rule) -> dict:
+        """Migrate a fleet run to a new life-like rule WITHOUT dropping
+        its board: the run is evicted from its current bucket (an exact
+        device readback), its bucket key re-homed under the new
+        rulestring, and readmitted through the existing placement queue.
+        The admission charge is held across the migration — geometry is
+        unchanged, so a migrating run can never lose its capacity to a
+        waiter. Returns the run's describe() record (state "queued"
+        until the loop re-places it). The legacy run0 is refused: its
+        rule is the engine's construction-time rule."""
+        self._check_alive()
+        rid = str(run_id or "")
+        if rule is None or rule == "":
+            raise RuntimeError("admission rejected: rule (empty)")
+        new_rule = self._resolve_rule(rule)
+        with self._fleet_lock:
+            if rid in ("", LEGACY_RUN_ID):
+                raise PermissionError(
+                    f"run {LEGACY_RUN_ID!r} is the legacy engine "
+                    "surface; its rule is fixed at construction")
+            h = self._runs.get(rid)
+            if h is None:
+                raise KeyError(f"unknown run {rid!r}")
+            if h.rule.rulestring != new_rule.rulestring:
+                self._migrate_rule_locked(h, new_rule)
+                obs.RUNS_RULE_MIGRATIONS.inc()
+            rec = h.describe()
+            self._wake.notify_all()
+        self._ensure_loop()
+        return rec
+
+    def _migrate_rule_locked(self, h: RunHandle, new_rule) -> None:
+        """Move a run between rule-keyed buckets, board intact. The old
+        slot frees immediately; placement into the new-rule bucket rides
+        the normal queue (same pass ordering as admissions)."""
+        if h.slot is not None:
+            bucket = self._buckets.get(h.bucket_key)
+            if bucket is not None:
+                if h.frozen is not None:
+                    # Paused/parked: the handle copy is authoritative
+                    # and the slot was stepping garbage — free it
+                    # without readback.
+                    bucket.release(h.slot)
+                else:
+                    h.frozen = bucket.evict(h.slot, h.h, h.w)
+            h.slot = None
+            if h.state == "resident":
+                h.state = "queued"
+                self._placeq.append(h)
+            # Parked runs stay parked, slotless: _resume_locked requeues
+            # them through placement when a drive resumes them.
+        hb, wb, _old = h.bucket_key
+        h.rule = new_rule
+        h.bucket_key = (hb, wb, new_rule.rulestring)
+        obs_log("fleet.rule_migrated", run_id=h.run_id,
+                rule=new_rule.rulestring, turn=h.turn, state=h.state)
 
     def _resolve_rule(self, rule):
         if rule is None:
@@ -520,7 +601,8 @@ class FleetEngine(ControlFlagProtocol):
             h = self._runs.get(LEGACY_RUN_ID)
             bucket_rows = [
                 {"shape": f"{b.hb}x{b.wb}", "cap": b.cap,
-                 "occupied": b.occupied, "dispatches": b.dispatches}
+                 "occupied": b.occupied, "dispatches": b.dispatches,
+                 "placement": b.placement, "devices": b.devices}
                 for b in self._buckets.values()]
             doc = {
                 "turn": h.turn if h else 0,
@@ -537,10 +619,20 @@ class FleetEngine(ControlFlagProtocol):
                 "fleet": {
                     "buckets": bucket_rows,
                     "chunk_turns": self.chunk_turns,
+                    "mesh": self._mesh_doc_locked(),
                     **self.runs_summary(),
                 },
             }
         doc["fleet"]["admission"] = self.admission.summary()
+        return doc
+
+    def _mesh_doc_locked(self) -> dict:
+        """Placement-mesh stamp for stats()/bench detail records: the
+        devices the fleet actually shards over (with the batch-mesh
+        geometry when one exists), never a bare jax.device_count()."""
+        doc: dict = {"devices": len(self._devices)}
+        if self._mesh_batch is not None:
+            doc.update(mesh_geometry(self._mesh_batch))
         return doc
 
     def _legacy_or_raise(self) -> RunHandle:
@@ -589,6 +681,13 @@ class FleetEngine(ControlFlagProtocol):
         # must not turn kill into a hang.
         if t is not None and t is not threading.current_thread():
             t.join(timeout=10.0)
+        with self._fleet_lock:
+            pool, self._ckpt_pool = self._ckpt_pool, None
+        if pool is not None:
+            try:
+                pool.close(timeout=5.0)
+            except Exception:
+                pass
 
     # --------------------------------------------------- checkpointing
 
@@ -684,20 +783,23 @@ class FleetEngine(ControlFlagProtocol):
 
     def _ckpt_cadence_locked(self, h: RunHandle) -> None:
         """Async per-run cadence checkpoint (loop thread, lock held):
-        snapshot capture is a pointer copy; the writer does the rest."""
+        snapshot capture is a pointer copy; the shared writer POOL does
+        the rest — a bounded worker set draining runs round-robin, not
+        one thread per resident run (PR 11)."""
         from gol_tpu import ckpt as ckpt_mod
 
         base = os.environ.get(CKPT_ENV, "")
         if not base:
             return
-        if h.ckpt_writer is None:
-            h.ckpt_writer = ckpt_mod.CheckpointWriter(
-                self._ckpt_dir(h.run_id, base), run_id=h.run_id,
-                keep_last=env_int(ckpt_mod.CKPT_KEEP_ENV,
-                                  ckpt_mod.CKPT_KEEP_DEFAULT),
-                keep_every=env_int(ckpt_mod.CKPT_KEEP_EVERY_ENV, 0,
-                                   minimum=0))
-        h.ckpt_writer.submit(self._snapshot_locked(h, "periodic"))
+        if self._ckpt_pool is None:
+            self._ckpt_pool = ckpt_mod.CheckpointWriterPool()
+        self._ckpt_pool.submit(
+            self._ckpt_dir(h.run_id, base), h.run_id,
+            self._snapshot_locked(h, "periodic"),
+            keep_last=env_int(ckpt_mod.CKPT_KEEP_ENV,
+                              ckpt_mod.CKPT_KEEP_DEFAULT),
+            keep_every=env_int(ckpt_mod.CKPT_KEEP_EVERY_ENV, 0,
+                               minimum=0))
 
     def restore_run(self, path: str) -> int:
         from gol_tpu import ckpt as ckpt_mod
@@ -863,7 +965,19 @@ class FleetEngine(ControlFlagProtocol):
         bucket = self._buckets.get(key)
         if bucket is None:
             hb, wb, _rs = key
-            bucket = Bucket(hb, wb, h.rule, slot_base=self.slot_base)
+            ndev = len(self._devices)
+            placement = choose_placement(hb, wb, self.slot_base, ndev)
+            if placement == "batch":
+                mesh = self._mesh_batch
+            elif placement == "spatial":
+                # Big-board fallback: too few slots per device to keep
+                # the batch axis occupied — row-shard each board via
+                # the halo machinery instead.
+                mesh = make_mesh(ndev, devices=self._devices)
+            else:
+                mesh = None
+            bucket = Bucket(hb, wb, h.rule, slot_base=self.slot_base,
+                            mesh=mesh, placement=placement)
             self._buckets[key] = bucket
             self._rr.append(key)
         return bucket
@@ -911,6 +1025,11 @@ class FleetEngine(ControlFlagProtocol):
                 obs.ENGINE_TURN.set(self._turn)
             obs.ENGINE_CHUNK_SIZE.set(self.chunk_turns)
             obs.RUNS_RESIDENT.set(self.runs_summary()["resident"])
+            obs.FLEET_MESH_DEVICES.set(len(self._devices))
+            for dev, n in enumerate(self._device_resident_locked()):
+                obs.FLEET_DEVICE_RESIDENT.labels(device=str(dev)).set(n)
+            if self._ckpt_pool is not None:
+                obs.CKPT_POOL_DEPTH.set(self._ckpt_pool.depth())
             self._flush_slo_locked(now, pend_quantum)
             last_flush = now
 
@@ -1079,6 +1198,29 @@ class FleetEngine(ControlFlagProtocol):
                 for ms, h in rows[:5]]
         obs_slo.set_fleet_health(doc)
 
+    def _device_resident_locked(self) -> List[int]:
+        """Resident-run count per placement-device index. Batch buckets
+        place slot s on device s // (cap // devices) — NamedSharding
+        splits the slot axis into equal contiguous blocks; spatial
+        buckets put every board on every device; single placement lives
+        entirely on device 0."""
+        counts = [0] * len(self._devices)
+        for b in self._buckets.values():
+            if b.placement == "batch":
+                block = b.cap // b.devices
+                for slot, h in enumerate(b.slots):
+                    if h is not None and h.state == "resident":
+                        counts[slot // block] += 1
+            else:
+                occupied = sum(
+                    1 for h in b.slots
+                    if h is not None and h.state == "resident")
+                if b.placement == "spatial":
+                    counts = [c + occupied for c in counts]
+                else:
+                    counts[0] += occupied
+        return counts
+
     def _next_bucket_locked(self):
         """Fair rotation: each non-empty bucket gets one quantum per
         cycle regardless of how many buckets exist or how full they
@@ -1123,7 +1265,12 @@ class FleetEngine(ControlFlagProtocol):
             board = h.frozen if h.frozen is not None \
                 else _soup(h.run_id, h.h, h.w)
             h.slot = bucket.place(h, board)
-            h.frozen = None
+            # A PAUSED handle's frozen board stays authoritative (the
+            # slot steps garbage until resume restamps it) — clearing
+            # it here would corrupt a paused run placed after a rule
+            # migration or checkpoint restore.
+            if not h.paused:
+                h.frozen = None
             h.state = "resident"
             h.advanced_s = time.monotonic()
         # Per-run: quarantine restores, seeds, flags, resumes, trims.
@@ -1228,6 +1375,14 @@ class FleetEngine(ControlFlagProtocol):
         h.done.set()
 
     def _resume_locked(self, h: RunHandle) -> None:
+        if h.slot is None:
+            # Slotless park (rule migration freed the old-bucket slot):
+            # resume goes back through placement — frozen reseeds the
+            # new bucket on the next service pass.
+            h.state = "queued"
+            if h not in self._placeq:
+                self._placeq.append(h)
+            return
         bucket = self._buckets[h.bucket_key]
         if not h.paused and h.frozen is not None:
             bucket.stamp(h.slot, h.frozen)
@@ -1384,12 +1539,11 @@ class FleetEngine(ControlFlagProtocol):
               and h.admitted_cost):
             self.admission.release(h.admitted_cost)
         h.state = "removed"
-        if h.ckpt_writer is not None:
-            try:
-                h.ckpt_writer.close()
-            except Exception:
-                pass
-            h.ckpt_writer = None
+        if self._ckpt_pool is not None:
+            # Pending snapshots drain (same flush-then-close semantics
+            # the per-run writer had); only the directory core is
+            # dropped so the pool's map cannot grow unboundedly.
+            self._ckpt_pool.forget(h.run_id)
         self._runs.pop(h.run_id, None)
         h.done.set()
 

@@ -72,7 +72,7 @@ class RunHandle:
         "run_id", "rule", "h", "w", "bucket_key", "slot", "turn",
         "alive", "alive_turn", "state", "paused", "frozen", "flags",
         "viewers", "ckpt_every", "next_ckpt_turn", "target_turn",
-        "done", "created_s", "pending_seed", "ckpt_writer", "abort",
+        "done", "created_s", "pending_seed", "abort",
         "admitted_cost", "enqueued_s", "advanced_s",
         "quarantine_reason", "quarantine_tries", "quarantine_next_s",
     )
@@ -116,7 +116,6 @@ class RunHandle:
         self.done = threading.Event()
         self.abort = threading.Event()
         self.created_s = time.time()
-        self.ckpt_writer = None  # lazy per-run CheckpointWriter
         # SLO telemetry (PR 8), monotonic clock: when the run entered
         # the admission wait queue (None = never queued), and when its
         # board last advanced — placement stamps it, each stepped

@@ -18,7 +18,8 @@ WIRE_METHODS = (
     "ServerDistributor", "Alivecount", "GetWorld", "GetView", "GetWindow",
     "CFput", "DrainFlags", "KillProg", "Ping", "Stats", "AbortRun",
     "GetMetrics", "Checkpoint", "RestoreRun", "Profile",
-    "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "unknown",
+    "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "SetRule",
+    "unknown",
 )
 
 # ----------------------------------------------------------------- engine
@@ -307,15 +308,47 @@ for _s in ("ok", "error"):
     RUNS_QUARANTINE_RESTORES.labels(status=_s)
 
 
+RUNS_RULE_MIGRATIONS = REGISTRY.counter(
+    "gol_runs_rule_migrations_total",
+    "SetRule bucket migrations: fleet runs moved between (size, "
+    "rulestring) bucket classes via evict -> readmit through the "
+    "placement queue, board preserved. No-op SetRule (same rulestring) "
+    "is not counted.")
+
+FLEET_DEVICE_RESIDENT = REGISTRY.gauge(
+    "gol_fleet_device_resident_runs",
+    "Resident fleet runs whose bucket slot lives on each device of the "
+    "engine's placement mesh (batch-axis buckets put each slot on one "
+    "device; spatially sharded buckets count their residents on every "
+    "device holding a row shard). Label cardinality is bounded by the "
+    "local device count, same as gol_dev_live_bytes.",
+    label_names=("device",))
+
+FLEET_MESH_DEVICES = REGISTRY.gauge(
+    "gol_fleet_mesh_devices",
+    "Devices in the fleet engine's placement mesh (1 = the unsharded "
+    "single-device fleet; gol_mesh_* carries the full geometry stamp).")
+
+
 def runs_doc() -> dict:
     """The /healthz runs summary: resident gauge + admission counters
-    (registry reads only — never a device sync or an engine lock)."""
+    (registry reads only — never a device sync or an engine lock).
+    On a mesh-placed fleet the summary also carries the placement
+    device count and the per-device resident split."""
     rejected = 0.0
     for child in RUNS_REJECTED.children().values():
         rejected += child.value
-    return {"resident": int(RUNS_RESIDENT.value),
-            "admitted_total": int(RUNS_ADMITTED.value),
-            "rejected_total": int(rejected)}
+    doc = {"resident": int(RUNS_RESIDENT.value),
+           "admitted_total": int(RUNS_ADMITTED.value),
+           "rejected_total": int(rejected)}
+    mesh_devices = int(FLEET_MESH_DEVICES.value)
+    if mesh_devices:
+        doc["mesh_devices"] = mesh_devices
+        doc["resident_by_device"] = {
+            key[0]: int(child.value)
+            for key, child in sorted(
+                FLEET_DEVICE_RESIDENT.children().items())}
+    return doc
 
 
 # -------------------------------------------------------- serving-tier SLOs
@@ -428,6 +461,19 @@ for _s in ("ok", "error", "dropped"):
 for _s in ("ok", "rejected", "error"):
     CKPT_RESTORES.labels(status=_s)
 
+CKPT_POOL_WRITERS = REGISTRY.gauge(
+    "gol_ckpt_pool_writers",
+    "Worker threads in the shared fleet checkpoint writer pool "
+    "(GOL_FLEET_CKPT_WRITERS; 0 until a fleet cadence checkpoint is "
+    "first submitted). Replaces the one-CheckpointWriter-per-run "
+    "design: 512 residents share this fixed pool.")
+CKPT_POOL_DEPTH = REGISTRY.gauge(
+    "gol_ckpt_pool_depth",
+    "Runs with a cadence snapshot pending in the shared writer pool "
+    "(newest-wins per run; superseded snapshots count as "
+    "gol_ckpt_writes_total{status='dropped'}). Bounded by resident "
+    "runs, drained round-robin.")
+
 # -------------------------------------------------------- device telemetry
 
 # Values come from devstats.poll_device_memory(); this module stays free
@@ -463,7 +509,7 @@ DEV_DEVICES = REGISTRY.gauge(
 # collective traffic of sharded dispatches (obs/halostats.py). Axis
 # labels are clamped to the declared mesh axes; device-count labels are
 # bounded by the local device count, same as gol_dev_live_bytes.
-MESH_AXES = ("rows", "cols")
+MESH_AXES = ("rows", "cols", "slots")
 
 MESH_DEVICES = REGISTRY.gauge(
     "gol_mesh_devices",

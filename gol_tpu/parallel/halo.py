@@ -146,6 +146,21 @@ def _packed_local_step(local: jax.Array, n_shards: int, rule: LifeLikeRule):
     return _rule_from_count_bits(local, n0, n1, n2, n3, rule)
 
 
+def batched_packed_local_step(batch: jax.Array, n_shards: int,
+                              rule: LifeLikeRule) -> jax.Array:
+    """One turn of one row-shard of a (cap, local_rows, wpb) packed fleet
+    bucket batch — the spatial-fallback inner step for big-board bucket
+    classes (`fleet/buckets.py`): the same ppermute ring halo exchange as
+    `_packed_local_step`, batched over the leading slot axis (the adder
+    network and rolls below touch only the trailing two axes, so every
+    slot rides the same exchanged halo rows)."""
+    top, bot = exchange_halos(batch, n_shards, ROWS_AXIS, depth=1, axis=1)
+    padded = jnp.concatenate([top, batch, bot], axis=1)
+    n0, n1, n2, n3 = neighbour_count_bits(
+        padded[:, :-2, :], batch, padded[:, 2:, :])
+    return _rule_from_count_bits(batch, n0, n1, n2, n3, rule)
+
+
 # Deep-halo macro-stepping (multi-shard packed path): instead of trading a
 # 1-row halo every turn, each macro-step trades a T-row halo once and then
 # advances T turns with no communication at all. The (rows + 2T)-row window

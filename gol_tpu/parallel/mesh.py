@@ -27,6 +27,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 ROWS_AXIS = "rows"
 
+# Fleet batch axis: a bucket's padded (cap, hb, wpb) batch is split over
+# the mesh along the SLOT axis — embarrassingly parallel, zero halo
+# traffic (the packed stencil rolls only along the trailing board axes).
+SLOTS_AXIS = "slots"
+
 
 def resolve_shard_count(height: int, requested: int) -> int:
     """Largest n ≤ requested with height % n == 0 (and n ≥ 1). A downgrade
@@ -65,6 +70,27 @@ def make_mesh(
 def board_sharding(mesh: Mesh) -> NamedSharding:
     """Board rows split over the mesh, columns replicated."""
     return NamedSharding(mesh, P(ROWS_AXIS, None))
+
+
+def make_batch_mesh(
+    num_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D mesh over the first `num_devices` devices, axis name 'slots' —
+    the fleet-bucket batch mesh (`fleet/buckets.py`). Kept distinct from
+    `make_mesh` so geometry stamps and jit caches can't confuse a
+    batch-axis placement with a row-sharded one."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = num_devices if num_devices is not None else len(devices)
+    if n > len(devices):
+        raise ValueError(
+            f"asked for {n} batch shards, have {len(devices)} devices")
+    return Mesh(np.array(devices[:n]), (SLOTS_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Bucket slots split over the mesh, each board fully on one device."""
+    return NamedSharding(mesh, P(SLOTS_AXIS, None, None))
 
 
 def mesh_geometry(mesh: Mesh) -> dict:

@@ -102,8 +102,8 @@ class EngineServer:
     # Mirror of the client's MUTATING_METHODS: the set whose replies are
     # recorded for replay. Read-only methods are naturally idempotent.
     MUTATING_METHODS = frozenset({
-        "CreateRun", "DestroyRun", "Checkpoint", "CFput", "DrainFlags",
-        "RestoreRun", "AbortRun", "Profile", "KillProg",
+        "CreateRun", "DestroyRun", "SetRule", "Checkpoint", "CFput",
+        "DrainFlags", "RestoreRun", "AbortRun", "Profile", "KillProg",
     })
 
     def serve_forever(self) -> None:
@@ -521,6 +521,15 @@ class EngineServer:
                 # answer FleetUnsupported, same as CreateRun.
                 rec = self.engine.destroy_run(
                     str(header.get("run_id") or ""))
+                self._reply(conn, {"ok": True, "run": rec})
+            elif method == "SetRule":
+                # Rule migration: evict -> re-home under the new rule's
+                # bucket -> readmit through the placement queue, board
+                # intact. The legacy run0 is refused (PermissionError,
+                # like DestroyRun) — its rule is fixed at construction.
+                rec = self.engine.set_rule(
+                    str(header.get("run_id") or ""),
+                    str(header.get("rule") or ""))
                 self._reply(conn, {"ok": True, "run": rec})
             elif method == "RestoreRun":
                 turn = self._restore_run(str(header.get("path", "")))

@@ -1,0 +1,393 @@
+"""Mesh-sharded fleet (PR 11): batched bucket dispatch across devices.
+
+Covers the tentpole's load-bearing claims on the 8 forced host devices
+the suite runs with: a bucket batch-sharded along its slot axis evolves
+every run BIT-IDENTICAL to the single-device fleet (and to the board's
+own torus — slot sharding must be invisible to the simulation), the
+admission budget is per-device-aware (default scales with the
+placement width, explicit budgets stay absolute), admitting into
+existing sharded capacity compiles NOTHING (the PR-4 step-signature
+counter is the witness), quarantine -> restore of a run living in a
+sharded slot is bit-exact, the per-bucket-class placement policy falls
+back to spatial sharding only where batch occupancy is too low, rule
+migration (SetRule) re-homes a run across buckets with its board
+intact, and the shared checkpoint-writer pool keeps the per-run
+double-buffer (newest-wins) semantics under a bounded thread count."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from gol_tpu.fleet import AdmissionController, FleetEngine, run_cost
+from gol_tpu.fleet.buckets import choose_placement
+from gol_tpu.models import CONWAY, parse_rule
+from gol_tpu.obs import catalog as obs_cat
+from gol_tpu.obs import devstats
+from gol_tpu.ops.bitpack import (
+    pack_np,
+    packed_run_turns,
+    unpack_np,
+    words_bytes_np,
+)
+from gol_tpu.params import Params
+
+DEVS = jax.devices()
+
+pytestmark = pytest.mark.skipif(
+    len(DEVS) < 4, reason="needs >=4 devices (conftest forces 8)")
+
+
+def _soup(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
+def _replay(seed01, turns, rule=CONWAY):
+    """Single-board device torus replay — the parity oracle."""
+    h, w = seed01.shape
+    assert w % 32 == 0
+    words = packed_run_turns(pack_np(seed01).view("<u4"), turns, rule)
+    return unpack_np(words_bytes_np(np.asarray(words)), h, w)
+
+
+def _wait(pred, timeout=60.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _mk(devices, **kw):
+    kw.setdefault("bucket_sizes", (64,))
+    kw.setdefault("chunk_turns", 4)
+    kw.setdefault("slot_base", 8)
+    return FleetEngine(devices=devices, **kw)
+
+
+def _teardown(eng, *run_ids):
+    for rid in run_ids:
+        try:
+            eng.destroy_run(rid)
+        except Exception:
+            pass
+    eng.kill_prog()
+
+
+# ------------------------------------------------- batch-sharded parity
+
+
+def test_batch_sharded_parity_vs_single_device_fleet():
+    """Every run in a 4-way batch-sharded bucket must park at its
+    target bit-identical to the same seed in a 1-device fleet AND to
+    the board's own torus — the slot axis is a pure layout choice."""
+    seeds = [_soup(64, 64, seed=100 + i) for i in range(6)]
+    boards = {}
+    for tag, devs in (("one", DEVS[:1]), ("four", DEVS[:4])):
+        eng = _mk(devs)
+        try:
+            assert (eng.stats()["fleet"]["mesh"]["devices"]
+                    == len(devs))
+            for i, seed in enumerate(seeds):
+                eng.create_run(64, 64, board=seed.copy(),
+                               run_id=f"r{i}", target_turn=12)
+            rows = None
+            for i in range(len(seeds)):
+                rv = eng.resolve_run(f"r{i}")
+                _wait(lambda: rv.describe_run()["state"] == "parked",
+                      what=f"{tag} fleet run r{i} to park")
+                got, turn = rv.get_world()
+                assert turn == 12
+                boards[(tag, i)] = (got != 0).astype(np.uint8)
+            rows = eng.stats()["fleet"]["buckets"]
+            assert rows and rows[0]["placement"] == (
+                "single" if len(devs) == 1 else "batch")
+            assert rows[0]["devices"] == len(devs)
+        finally:
+            _teardown(eng, *[f"r{i}" for i in range(len(seeds))])
+    for i, seed in enumerate(seeds):
+        expect = _replay(seed, 12)
+        np.testing.assert_array_equal(boards[("one", i)], expect)
+        np.testing.assert_array_equal(boards[("four", i)], expect)
+
+
+# -------------------------------------------- per-device admission math
+
+
+def test_admission_budget_scales_with_placement_devices(monkeypatch):
+    monkeypatch.delenv("GOL_FLEET_MEM_BUDGET", raising=False)
+    base = AdmissionController(devices=1).budget_bytes()
+    assert AdmissionController(devices=4).budget_bytes() == 4 * base
+    # Explicit budgets are ABSOLUTE: a pinned byte count means that
+    # byte count no matter how wide the placement is.
+    assert AdmissionController(budget_bytes=12345,
+                               devices=4).budget_bytes() == 12345
+    monkeypatch.setenv("GOL_FLEET_MEM_BUDGET", "54321")
+    assert AdmissionController(devices=8).budget_bytes() == 54321
+
+
+def test_engine_admission_is_placement_aware(monkeypatch):
+    monkeypatch.delenv("GOL_FLEET_MEM_BUDGET", raising=False)
+    eng = _mk(DEVS[:4])
+    try:
+        s = eng.admission.summary()
+        assert s["devices"] == 4
+        assert s["budget_bytes"] == (
+            AdmissionController(devices=4).budget_bytes())
+        eng.create_run(64, 64, run_id="acct")
+        assert eng.admission.summary()["committed_bytes"] == (
+            run_cost(64, 64 // 32))
+    finally:
+        _teardown(eng, "acct")
+
+
+# ------------------------------- admit-into-capacity compiles nothing
+
+
+def test_admit_into_sharded_capacity_compiles_nothing():
+    """After the first dispatch warms the (cap, quantum) program, every
+    further admission that fits the sharded capacity must add ZERO step
+    signatures — pow2 slot growth per shard keeps the shape stable."""
+    eng = _mk(DEVS[:4])
+    try:
+        eng.create_run(64, 64, board=_soup(64, 64, seed=1),
+                       run_id="w0", target_turn=8)
+        rv = eng.resolve_run("w0")
+        _wait(lambda: rv.describe_run()["state"] == "parked",
+              what="warm run to park")
+        sig0 = devstats.signature_count()
+        for i in range(5):
+            eng.create_run(64, 64, board=_soup(64, 64, seed=2 + i),
+                           run_id=f"c{i}", target_turn=8)
+        for i in range(5):
+            rv = eng.resolve_run(f"c{i}")
+            _wait(lambda: rv.describe_run()["state"] == "parked",
+                  what=f"capacity run c{i} to park")
+        assert devstats.signature_count() == sig0
+    finally:
+        _teardown(eng, "w0", *[f"c{i}" for i in range(5)])
+
+
+# --------------------------------------- quarantine of a sharded slot
+
+
+@pytest.mark.timeout(150)
+def test_quarantine_restores_sharded_slot_bit_identical(monkeypatch,
+                                                        tmp_path):
+    """A poisoned run living in a batch-sharded bucket quarantines and
+    auto-restores from its cadence checkpoint bit-identical to a clean
+    replay — the host slot gather must survive the resharded slot."""
+    from gol_tpu import chaos
+
+    monkeypatch.setenv("GOL_CKPT", str(tmp_path / "ck"))
+    monkeypatch.setenv("GOL_QUARANTINE_BACKOFF", "0.05")
+    board = _soup(64, 64, seed=7)
+    eng = _mk(DEVS[:4])
+    try:
+        assert eng.stats()["fleet"]["buckets"] == []
+        eng.create_run(64, 64, board=board.copy(), run_id="clean",
+                       ckpt_every=8, target_turn=40)
+        hc = eng._runs["clean"]
+        assert hc.done.wait(60)
+        clean_board, clean_turn = eng._run_board(hc)
+        assert eng.stats()["fleet"]["buckets"][0]["placement"] == "batch"
+
+        q0 = obs_cat.RUNS_QUARANTINED.labels(reason="popcount").value
+        monkeypatch.setenv(chaos.ENV, "poison=victim@20,seed=1")
+        eng.create_run(64, 64, board=board.copy(), run_id="victim",
+                       ckpt_every=8, target_turn=40)
+        hv = eng._runs["victim"]
+        assert hv.done.wait(90), f"victim stuck in state {hv.state}"
+        monkeypatch.delenv(chaos.ENV)
+
+        vb, vt = eng._run_board(hv)
+        assert vt == clean_turn == 40
+        assert np.array_equal(vb, clean_board)
+        assert (obs_cat.RUNS_QUARANTINED.labels(
+            reason="popcount").value - q0) == 1
+        assert eng.runs_summary()["quarantined"] == 0
+    finally:
+        _teardown(eng, "clean", "victim")
+
+
+# --------------------------------------------- spatial fallback policy
+
+
+def test_choose_placement_policy():
+    assert choose_placement(64, 64, 8, 1) == "single"
+    # occupancy >= min_slots_per_device -> batch (the default regime)
+    assert choose_placement(64, 64, 8, 4) == "batch"
+    # low occupancy + rows divide the mesh -> spatial row sharding
+    assert choose_placement(64, 64, 2, 4) == "spatial"
+    # low occupancy + indivisible rows -> batch, paying the pad
+    assert choose_placement(50, 64, 2, 4) == "batch"
+
+
+def test_min_slots_env_flips_policy(monkeypatch):
+    monkeypatch.setenv("GOL_FLEET_MIN_SLOTS_PER_DEV", "4")
+    assert choose_placement(64, 64, 8, 4) == "spatial"
+    monkeypatch.setenv("GOL_FLEET_MIN_SLOTS_PER_DEV", "1")
+    assert choose_placement(64, 64, 8, 4) == "batch"
+
+
+def test_spatial_fallback_bucket_parity():
+    """A big-board class below batch occupancy builds a SPATIAL bucket
+    (row sharding via the halo path) and still parks bit-identical to
+    the torus oracle."""
+    eng = _mk(DEVS[:4], slot_base=2)
+    try:
+        seed = _soup(64, 64, seed=31)
+        eng.create_run(64, 64, board=seed, run_id="sp", target_turn=12)
+        rv = eng.resolve_run("sp")
+        _wait(lambda: rv.describe_run()["state"] == "parked",
+              what="spatial run to park")
+        rows = eng.stats()["fleet"]["buckets"]
+        assert rows[0]["placement"] == "spatial"
+        assert rows[0]["devices"] == 4
+        got, turn = rv.get_world()
+        assert turn == 12
+        np.testing.assert_array_equal((got != 0).astype(np.uint8),
+                                      _replay(seed, 12))
+    finally:
+        _teardown(eng, "sp")
+
+
+# --------------------------------------------------- SetRule migration
+
+
+def test_set_rule_migrates_board_intact():
+    """SetRule moves a run between rule-keyed buckets without touching
+    its board: the parked state survives, and further turns evolve
+    under the NEW rule exactly as the board's torus would."""
+    highlife = parse_rule("B36/S23")
+    eng = _mk(DEVS[:4])
+    try:
+        seed = _soup(64, 64, seed=55)
+        eng.create_run(64, 64, board=seed, run_id="mig",
+                       target_turn=8)
+        rv = eng.resolve_run("mig")
+        _wait(lambda: rv.describe_run()["state"] == "parked",
+              what="mig run to park")
+        mid, turn = rv.get_world()
+        assert turn == 8
+        mid01 = (mid != 0).astype(np.uint8)
+
+        m0 = obs_cat.RUNS_RULE_MIGRATIONS.value
+        rec = eng.set_rule("mig", "B36/S23")
+        assert rec["rule"] == highlife.rulestring
+        assert obs_cat.RUNS_RULE_MIGRATIONS.value - m0 == 1
+        # Board untouched by the migration itself.
+        got, turn = rv.get_world()
+        assert turn == 8
+        np.testing.assert_array_equal((got != 0).astype(np.uint8),
+                                      mid01)
+        # Driving onward evolves under the new rule.
+        px, turn = rv.server_distributor(
+            Params(threads=1, image_width=64, image_height=64,
+                   turns=8), None)
+        assert turn == 16
+        np.testing.assert_array_equal(
+            (px != 0).astype(np.uint8), _replay(mid01, 8, highlife))
+        # Idempotent: same rule again migrates nothing.
+        eng.set_rule("mig", "B36/S23")
+        assert obs_cat.RUNS_RULE_MIGRATIONS.value - m0 == 1
+
+        with pytest.raises(RuntimeError):
+            eng.set_rule("mig", "")
+        with pytest.raises(PermissionError):
+            eng.set_rule("run0", "B36/S23")
+        with pytest.raises(KeyError):
+            eng.set_rule("nope", "B36/S23")
+    finally:
+        _teardown(eng, "mig")
+
+
+# --------------------------------------------- checkpoint writer pool
+
+
+def test_ckpt_pool_newest_wins_and_drains(monkeypatch, tmp_path):
+    from gol_tpu.ckpt import CheckpointWriterPool, Snapshot
+    from gol_tpu.ckpt import manifest as mf
+
+    pool = CheckpointWriterPool(workers=1)
+    # Hold the workers back so the replacement is deterministic.
+    monkeypatch.setattr(CheckpointWriterPool, "_ensure_threads",
+                        lambda self: None)
+    d0 = obs_cat.CKPT_WRITES.labels(status="dropped").value
+
+    def snap(turn):
+        cells = np.zeros((8, 1), dtype="<u4")
+        cells[0, 0] = turn  # distinguishable payloads
+        return Snapshot(cells, "packed", 0, turn, (8, 32), "B3/S23")
+
+    assert pool.submit(str(tmp_path / "run-a"), "a", snap(4)) is True
+    assert pool.submit(str(tmp_path / "run-a"), "a", snap(8)) is False
+    assert pool.submit(str(tmp_path / "run-b"), "b", snap(4)) is True
+    assert pool.depth() == 2  # newest-wins collapsed run a's backlog
+    assert (obs_cat.CKPT_WRITES.labels(status="dropped").value
+            - d0) == 1
+
+    monkeypatch.undo()
+    pool._ensure_threads()
+    assert pool.close(timeout=30.0)
+    # Only the NEWEST snapshot of run a landed; run b's landed too.
+    latest = mf.latest_checkpoint(str(tmp_path / "run-a"))
+    assert latest is not None and latest[0] == 8
+    latest_b = mf.latest_checkpoint(str(tmp_path / "run-b"))
+    assert latest_b is not None and latest_b[0] == 4
+    with pytest.raises(RuntimeError):
+        pool.submit(str(tmp_path / "run-a"), "a", snap(12))
+
+
+def test_fleet_cadence_uses_shared_pool(monkeypatch, tmp_path):
+    """Engine cadence checkpoints ride ONE shared pool, not a writer
+    thread per run; removing a run forgets its core but still drains
+    its pending snapshot."""
+    monkeypatch.setenv("GOL_CKPT", str(tmp_path))
+    from gol_tpu.ckpt import manifest as mf
+
+    eng = _mk(DEVS[:4])
+    try:
+        for i in range(3):
+            eng.create_run(64, 64, board=_soup(64, 64, seed=80 + i),
+                           run_id=f"p{i}", ckpt_every=4, target_turn=8)
+        for i in range(3):
+            rv = eng.resolve_run(f"p{i}")
+            _wait(lambda: rv.describe_run()["state"] == "parked",
+                  what=f"pool run p{i} to park")
+        assert eng._ckpt_pool is not None
+        assert eng._ckpt_pool.flush(timeout=30.0)
+        for i in range(3):
+            latest = mf.latest_checkpoint(str(tmp_path / f"run-p{i}"))
+            assert latest is not None and latest[0] >= 4
+    finally:
+        _teardown(eng, "p0", "p1", "p2")
+
+
+# ------------------------------------------------ per-device telemetry
+
+
+def test_per_device_resident_attribution():
+    """gol_fleet_device_resident_runs attributes each resident run to
+    the device its slot block lives on; /healthz runs_doc mirrors it."""
+    eng = _mk(DEVS[:4])
+    try:
+        for i in range(4):
+            eng.create_run(64, 64, board=_soup(64, 64, seed=60 + i),
+                           run_id=f"d{i}")
+        counts = eng._device_resident_locked()
+        assert len(counts) == 4 and sum(counts) == 4
+        _wait(lambda: sum(
+            obs_cat.FLEET_DEVICE_RESIDENT.labels(device=str(d)).value
+            for d in range(4)) == 4,
+            what="per-device resident gauges to flush")
+        from gol_tpu.obs import catalog
+        doc = catalog.runs_doc()
+        assert doc["mesh_devices"] == 4
+        assert sum(doc["resident_by_device"].values()) == 4
+    finally:
+        _teardown(eng, "d0", "d1", "d2", "d3")

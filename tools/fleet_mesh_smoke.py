@@ -1,0 +1,175 @@
+"""fleet-mesh-smoke: prove the mesh-sharded fleet end to end.
+
+Runs a reduced `bench.py --fleet --mesh` matrix IN-PROCESS on CPU
+(8 forced host devices): the 1-way baseline and one 4-way leg, 64
+resident 512² runs each. Then validates every surface the tentpole is
+supposed to light up:
+
+  * the emitted bench lines parse, are parity-clean (the 4-way board
+    is BIT-IDENTICAL to the 1-device fleet's), stamp the true
+    placement mesh (batch placement over 4 devices — never a bare
+    jax.device_count()), and retired turns with ZERO new step
+    signatures inside the measurement window;
+  * the fleet_scaling_efficiency_pct line exists for the 4-way leg;
+  * the gol_fleet_mesh_devices gauge and the per-device
+    gol_fleet_device_resident_runs children are populated in the
+    registry after the run;
+  * `catalog.runs_doc()` (the /healthz runs summary) carries the
+    mesh_devices stamp;
+  * tools/perf_compare.py gates the captured lines against the
+    committed BASELINE.json floors (per-device cups and
+    fleet_scaling_efficiency_pct, higher is better).
+
+Exit 0 = pass.
+
+    make fleet-mesh-smoke     # part of the `make smoke` chain
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+from contextlib import redirect_stdout
+
+# Runnable as `python tools/fleet_mesh_smoke.py` from a bare clone: put
+# the repo root (this file's parent's parent) ahead of tools/ on
+# sys.path.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The legs need devices; force 8 virtual host devices strictly before
+# any jax backend initialisation (same guard as bench.py --mesh).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+SMOKE_WAYS = (1, 4)
+SMOKE_RUNS = (64,)
+SMOKE_SIZE = 512
+SMOKE_WINDOW_S = 1.0
+
+
+def main() -> int:
+    import bench
+
+    problems = []
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.bench_fleet_mesh(ways=SMOKE_WAYS,
+                                    run_counts=SMOKE_RUNS,
+                                    n=SMOKE_SIZE,
+                                    window_s=SMOKE_WINDOW_S)
+    captured = buf.getvalue()
+    sys.stdout.write(captured)
+    if rc != 0:
+        problems.append(f"bench_fleet_mesh rc={rc} "
+                        f"(parity/signature gate failed?)")
+
+    # ---- bench lines ---------------------------------------------------
+    recs = []
+    for line in captured.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            recs.append(json.loads(line))
+        except ValueError:
+            problems.append(f"unparseable bench line: {line[:80]!r}")
+    names = {r.get("metric", "") for r in recs}
+    runs, n = SMOKE_RUNS[0], SMOKE_SIZE
+    for needed in (
+            f"aggregate cell-updates/sec (fleet-mesh, 1-way, "
+            f"{runs} x {n}x{n} runs)",
+            f"per-device cell-updates/sec (fleet-mesh, 4-way, "
+            f"{runs} x {n}x{n} runs)",
+            f"fleet_scaling_efficiency_pct (4-way, "
+            f"{runs} x {n}x{n} runs)"):
+        if needed not in names:
+            problems.append(f"missing bench line {needed!r}")
+    for r in recs:
+        d = r.get("detail", {})
+        if d.get("alive_parity") is not True:
+            problems.append(f"parity not clean on {r.get('metric')!r}")
+        if d.get("new_step_signatures_in_window"):
+            problems.append(
+                f"step signatures moved inside the window of "
+                f"{r.get('metric')!r}")
+        ways = d.get("ways")
+        if ways == 4:
+            if d.get("placement") != "batch":
+                problems.append(f"4-way leg placement is "
+                                f"{d.get('placement')!r}, want 'batch'")
+            mesh = d.get("mesh") or {}
+            if mesh.get("devices") != 4 \
+                    or mesh.get("axes") != {"slots": 4}:
+                problems.append(
+                    f"bad placement mesh in detail: {mesh!r}")
+        elif ways == 1 and d.get("devices") != 1:
+            problems.append(
+                f"1-way leg stamps devices={d.get('devices')!r} — the "
+                f"placement mesh, not jax.device_count(), must be "
+                f"reported")
+
+    # ---- registry families hold real samples ---------------------------
+    from gol_tpu.obs.metrics import REGISTRY
+
+    samples = {}
+    for line in REGISTRY.render_prometheus().splitlines():
+        if line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            samples[key] = float(val)
+        except ValueError:
+            pass
+    if samples.get("gol_fleet_mesh_devices", 0) <= 0:
+        problems.append(
+            f"gol_fleet_mesh_devices not populated: "
+            f"{samples.get('gol_fleet_mesh_devices')}")
+    dev_children = [k for k in samples
+                    if k.startswith("gol_fleet_device_resident_runs{")]
+    if len(dev_children) < 4:
+        problems.append(
+            f"per-device resident gauge has {len(dev_children)} "
+            f"children, want >= 4 (one per placement device)")
+
+    # ---- /healthz runs summary mesh stamp ------------------------------
+    from gol_tpu.obs import catalog as obs_cat
+
+    doc = obs_cat.runs_doc()
+    if not doc.get("mesh_devices"):
+        problems.append(f"runs_doc carries no mesh_devices: {doc!r}")
+
+    # ---- perf_compare gate round-trip ----------------------------------
+    import perf_compare
+
+    tmpdir = tempfile.mkdtemp(prefix="gol_fleet_mesh_smoke_")
+    out_path = os.path.join(tmpdir, "fleet_mesh.jsonl")
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(captured)
+    if perf_compare.main([os.path.join(_ROOT, "BASELINE.json"),
+                          out_path]) != 0:
+        problems.append("perf_compare gate failed on the fleet-mesh "
+                        "legs")
+
+    if problems:
+        for p in problems:
+            print(f"fleet-mesh-smoke: FAIL: {p}", file=sys.stderr)
+        return 1
+    effs = [r["value"] for r in recs
+            if str(r.get("metric", "")).startswith(
+                "fleet_scaling_efficiency_pct")]
+    print(f"fleet-mesh-smoke: OK — {len(recs)} fleet-mesh line(s), "
+          f"4-way bit-identical to the 1-device fleet, "
+          f"efficiency {effs[0] if effs else '?'}% on shared-core "
+          f"virtual devices")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

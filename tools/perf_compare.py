@@ -62,8 +62,8 @@ DEFAULT_NOISE_FLOOR = 5.0
 DEFAULT_MAX_REGRESSION = 10.0
 DEFAULT_GATE_PATTERN = (
     r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us"
-    r"|rpc p\d+ ms|efficiency_pct|overlap_pct"
-    r"|availability_pct|retries_per_call")
+    r"|rpc p\d+ ms|efficiency_pct|fleet_scaling_efficiency_pct"
+    r"|overlap_pct|availability_pct|retries_per_call")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
