@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -96,8 +96,23 @@ mesh-smoke:
 fleet-mesh-smoke:
 	JAX_PLATFORMS=cpu python tools/fleet_mesh_smoke.py
 
+# Federation failover check, CPU-only: bench.py --federation runs 3
+# real --fleet --federate servers behind an in-process router, lets
+# the GOL_CHAOS kill_member hook SIGKILL the member owning run 0
+# mid-traffic, and must stay bit-identical to an unkilled control
+# fleet; the availability_pct floor and the failover_downtime_p99_ms /
+# router_overhead_p99_ms ceilings gate via BASELINE.json.
+# tools/federation_smoke.py then proves membership, HRW placement,
+# adoption, viewer re-route, and the gol_fed_* families end to end.
+federation-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --federation \
+		| tee out/federation_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/federation_smoke.jsonl
+	JAX_PLATFORMS=cpu python tools/federation_smoke.py
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

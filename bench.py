@@ -1714,6 +1714,269 @@ def bench_chaos(n: int = CHAOS_BOARD, turns: int = CHAOS_TURNS,
     return rc
 
 
+FED_MEMBERS = 3
+FED_RUNS = 6
+FED_BOARD = 64
+FED_TARGET = 32
+FED_WARM_WINDOW_S = 2.0
+
+
+def bench_federation(members: int = FED_MEMBERS, runs: int = FED_RUNS,
+                     n: int = FED_BOARD,
+                     target: int = FED_TARGET) -> int:
+    """Federation failover leg (PR 12): `members` real `--fleet
+    --federate` server processes behind an in-process
+    FederationRouter, `runs` seeded boards HRW-placed through the
+    router and parked at a target turn with per-run manifests under
+    one shared checkpoint root. After a steady-state routed-traffic
+    window, GOL_CHAOS `kill_member` picks the member owning run 0 and
+    the harness SIGKILLs it mid-traffic; the router must declare it
+    dead, adopt its runs onto survivors, and keep answering routed
+    calls throughout. Emits three GATED lines: availability_pct over
+    every routed protected call (floor — calls during the failover
+    window BLOCK under GOL_FED_REROUTE and then succeed, so only a
+    broken failover path drops this), failover_downtime_p99_ms (the
+    blocked wait a victim-run call experiences from SIGKILL to its
+    first routed success — detection + adoption + restore, the number
+    an operator's SLO budget actually spends), and
+    router_overhead_p99_ms (the proxy's added latency in the
+    steady-state window, client-facing wall minus the member round
+    trip). Hard-fails independently of the perf gate when any
+    post-failover board diverges from an unkilled in-process control
+    fleet of the same seeds (or from the device torus replay oracle),
+    when chaos injected nothing, or when any run is lost."""
+    import os
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import federation_smoke as fed
+
+    from gol_tpu import chaos
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.federation.router import FederationRouter
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.obs import slo as obs_slo
+
+    for var in ("GOL_CHAOS", "GOL_RPC_RETRIES", "GOL_RULE",
+                "GOL_CKPT", "GOL_CKPT_EVERY_TURNS"):
+        os.environ.pop(var, None)
+    os.environ.update(fed.FED_ENV)
+    tmpdir = tempfile.mkdtemp(prefix="gol_fed_bench_")
+    ckpt_root = os.path.join(tmpdir, "ck")
+    router = FederationRouter(port=0).start_background()
+    procs = [fed.spawn_member(tmpdir, ckpt_root, router.port,
+                              ckpt_every=4) for _ in range(members)]
+    samples = []            # (ok, wall_s) per routed protected call
+    downtimes_ms = {}       # victim run_id -> ms to first success
+    rc = 0
+    try:
+        addrs = []
+        for p in procs:
+            addr = fed.wait_member(p)
+            if addr is None:
+                print("BENCH LEG FAILED (federation): a member never "
+                      "announced its port", file=sys.stderr)
+                return 1
+            addrs.append(addr)
+        if not fed.wait_live(router, members):
+            print("BENCH LEG FAILED (federation): registry never saw "
+                  f"{members} live members", file=sys.stderr)
+            return 1
+        cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=60.0)
+        rng = np.random.default_rng(21)
+        seeds = {}
+        for i in range(runs):
+            rid = f"b{i}"
+            seeds[rid] = (rng.random((n, n)) < 0.3).astype(np.uint8)
+            cli.create_run(n, n, board=seeds[rid], run_id=rid,
+                           ckpt_every=4, target_turn=target)
+        ids = sorted(seeds)
+        owners = fed.wait_runs_at(cli, ids, target)
+        if owners is None:
+            print("BENCH LEG FAILED (federation): runs never parked "
+                  "at their target turn", file=sys.stderr)
+            return 1
+        bound = {rid: cli.for_run(rid) for rid in ids}
+
+        def protected_call(rid) -> bool:
+            t0 = time.perf_counter()
+            try:
+                bound[rid].stats()
+                ok = True
+            except Exception:
+                ok = False
+            samples.append((ok, time.perf_counter() - t0))
+            return ok
+
+        # Steady-state routed traffic: populates the router's overhead
+        # estimator with failover-free samples.
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < FED_WARM_WINDOW_S:
+            for rid in ids:
+                protected_call(rid)
+        o50, o95, o99 = (
+            v * 1e3 if v is not None else None
+            for v in router._overhead.percentiles((0.50, 0.95, 0.99)))
+        steady_calls = len(samples)
+
+        # Chaos picks WHICH member dies and WHEN; the harness owns the
+        # subprocess and delivers the SIGKILL when the hook fires.
+        victim = owners["b0"]
+        victim_runs = sorted(r for r in ids if owners[r] == victim)
+        injected0 = sum(c.value for c in
+                        obs_cat.CHAOS_INJECTED.children().values())
+        os.environ["GOL_CHAOS"] = f"kill_member={victim}@0.4,seed=5"
+        t_kill = None
+        try:
+            t_arm = time.perf_counter()
+            while t_kill is None:
+                elapsed = time.perf_counter() - t_arm
+                if elapsed > 10.0:
+                    print("BENCH LEG FAILED (federation): kill_member "
+                          "never fired", file=sys.stderr)
+                    return 1
+                for i, addr in enumerate(addrs):
+                    if chaos.take_kill_member(addr, i, elapsed):
+                        os.kill(procs[i].pid, signal.SIGKILL)
+                        procs[i].wait(10)
+                        t_kill = time.perf_counter()
+                        break
+                else:
+                    for rid in ids:
+                        protected_call(rid)
+        finally:
+            os.environ.pop("GOL_CHAOS", None)
+        injected = sum(c.value for c in
+                       obs_cat.CHAOS_INJECTED.children().values()
+                       ) - injected0
+
+        # Downtime per victim run: the blocked wait from SIGKILL to
+        # the first routed success (detection + adoption + restore).
+        def recover(rid):
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline:
+                if protected_call(rid):
+                    downtimes_ms[rid] = round(
+                        (time.perf_counter() - t_kill) * 1e3, 1)
+                    return
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=recover, args=(rid,),
+                                    daemon=True)
+                   for rid in victim_runs]
+        for t in threads:
+            t.start()
+        # Survivor-run traffic keeps flowing through the whole window.
+        while any(t.is_alive() for t in threads):
+            for rid in ids:
+                if rid not in victim_runs:
+                    protected_call(rid)
+            for t in threads:
+                t.join(timeout=0.05)
+        if len(downtimes_ms) != len(victim_runs):
+            print(f"BENCH LEG FAILED (federation): "
+                  f"{sorted(set(victim_runs) - set(downtimes_ms))} "
+                  f"never recovered after the kill", file=sys.stderr)
+            return 1
+
+        # Parity: every run through the SAME router address vs an
+        # unkilled in-process control fleet of the same seeds, and vs
+        # the device torus replay oracle.
+        post = fed.wait_runs_at(cli, ids, target, timeout=240.0)
+        if post is None:
+            print("BENCH LEG FAILED (federation): runs never re-"
+                  "parked after failover", file=sys.stderr)
+            return 1
+        os.environ["GOL_CKPT"] = os.path.join(tmpdir, "ck_control")
+        from gol_tpu.fleet import FleetEngine
+
+        ctrl = FleetEngine(bucket_sizes=(n,), chunk_turns=4,
+                           slot_base=max(4, runs))
+        try:
+            for rid in ids:
+                ctrl.create_run(n, n, board=seeds[rid].copy(),
+                                run_id=rid, target_turn=target)
+            for rid in ids:
+                if not ctrl._runs[rid].done.wait(120):
+                    print("BENCH LEG FAILED (federation): control "
+                          f"run {rid} never finished", file=sys.stderr)
+                    return 1
+                cb, ct = ctrl._run_board(ctrl._runs[rid])
+                fb, ft = bound[rid].get_world()
+                ok_ctrl = ct == ft == target and np.array_equal(
+                    (fb != 0), (cb != 0))
+                ok_oracle = np.array_equal(
+                    (fb != 0).astype(np.uint8),
+                    fed.expected_board01(seeds[rid], target))
+                if not (ok_ctrl and ok_oracle):
+                    print(f"PARITY FAIL (federation): {rid} vs "
+                          f"control={ok_ctrl} (turns {ft}/{ct}), vs "
+                          f"oracle={ok_oracle}", file=sys.stderr)
+                    rc |= 1
+        finally:
+            ctrl.kill_prog()
+            os.environ.pop("GOL_CKPT", None)
+        if injected < 1:
+            print("BENCH LEG FAILED (federation): GOL_CHAOS injected "
+                  "no kill_member — the failover would be vacuous",
+                  file=sys.stderr)
+            rc |= 1
+
+        calls = len(samples)
+        failures = sum(1 for ok, _ in samples if not ok)
+        availability = 100.0 * (calls - failures) / max(calls, 1)
+        dt_vals = sorted(downtimes_ms.values())
+        dt_p99 = obs_slo.exact_percentiles(
+            [v / 1e3 for v in dt_vals], (0.99,))[0] * 1e3
+        detail = {
+            "members": members, "runs": runs, "size": n,
+            "target_turn": target,
+            "victim": victim, "victim_runs": victim_runs,
+            "adopted_to": {r: post[r] for r in victim_runs},
+            "routed_calls": calls, "failures": failures,
+            "steady_calls": steady_calls,
+            "downtime_ms_per_victim_run": downtimes_ms,
+            "router_overhead_ms": {"p50": o50, "p95": o95, "p99": o99,
+                                   "samples": router._overhead.count},
+            "fed_env": dict(fed.FED_ENV),
+            "chaos_injected": int(injected),
+            "parity_check": "every post-failover board vs an unkilled "
+                            "in-process control fleet of the same "
+                            "seeds AND vs the device torus replay, "
+                            "bit-identical at the target turn",
+            "method": "run-scoped Stats through the router (the "
+                      "client retry/req_id surface); victim-run calls "
+                      "issued at SIGKILL block under GOL_FED_REROUTE "
+                      "until adoption re-homes the run — that wait is "
+                      "the downtime; overhead is client-facing wall "
+                      "minus the member round trip, steady-state "
+                      "window only",
+        }
+        _emit("availability_pct (federation, routed traffic)",
+              round(availability, 3), "%", None, detail)
+        _emit("failover_downtime_p99_ms (federation, SIGKILL member)",
+              round(dt_p99, 1), "ms", None, detail)
+        _emit("router_overhead_p99_ms (federation, steady state)",
+              round(o99, 3) if o99 is not None else -1.0, "ms", None,
+              detail)
+        if o99 is None:
+            print("BENCH LEG FAILED (federation): no steady-state "
+                  "overhead samples", file=sys.stderr)
+            rc |= 1
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(10)
+        router.shutdown()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=None,
@@ -1791,6 +2054,14 @@ def main() -> int:
                          "bit-identical or fail (emits the gated "
                          "availability_pct / rpc_retries_per_call "
                          "lines)")
+    ap.add_argument("--federation", action="store_true",
+                    help="run the federation failover leg only: 3 "
+                         "--fleet --federate member processes behind "
+                         "an in-process router, one SIGKILLed by the "
+                         "GOL_CHAOS kill_member hook mid-traffic "
+                         "(emits the gated availability_pct / "
+                         "failover_downtime_p99_ms / "
+                         "router_overhead_p99_ms lines)")
     ap.add_argument("--mesh", action="store_true",
                     help="run the multi-device scaling legs only: "
                          "strong (fixed 1024²) and weak (256 rows/dev) "
@@ -1898,6 +2169,16 @@ def main() -> int:
 
 
 def _dispatch(args, ap) -> int:
+    if args.federation:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.chaos or args.fleet or args.load \
+                or args.mesh or args.size is not None \
+                or args.turns is not None:
+            ap.error("--federation is its own config; it takes no "
+                     "other leg flags")
+        return bench_federation()
+
     if args.mesh and args.fleet:
         # The mesh-sharded fleet matrix (PR 11): run-count x mesh-width
         # legs of batched bucket dispatch sharded over the device mesh.

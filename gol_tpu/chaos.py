@@ -23,6 +23,18 @@ given seed yields the same fault sequence on every run):
 - ``stall=p`` / ``stall_ms=N``
                   long sleep (default 1000 ms) — outlasts typical
                   client read timeouts, exercising the timeout path.
+- ``refuse=p``    dial-time refusal: the client-side connect raises
+                  ConnectionRefusedError before the socket ever
+                  connects (fires from `dial_hook`, not the send/recv
+                  hooks — exercising the dial-retry attribution path).
+- ``kill_member=<addr|idx>[@s]``
+                  arm the federation process-kill hook:
+                  `take_kill_member(addr, idx, elapsed_s)` fires exactly
+                  once per process when the harness polling it reports
+                  elapsed seconds >= s (omitted s draws a seeded time in
+                  [0.5, 1.5) s) for the member whose address or index
+                  matches. Chaos decides WHICH member and WHEN; the
+                  harness owning the subprocess delivers the SIGKILL.
 - ``seed=N``      RNG seed (default 0).
 - ``poison=<run_id>[@<turn>]``
                   arm the fleet poison hook: `take_poison(run_id, turn)`
@@ -61,7 +73,7 @@ def _parse(spec: str) -> dict:
         key, _, val = part.partition("=")
         key = key.strip()
         val = val.strip()
-        if key == "poison":
+        if key in ("poison", "kill_member"):
             cfg[key] = val
         elif key == "seed":
             try:
@@ -90,8 +102,25 @@ class ChaosInjector:
                                    0.01 if self.delay_ms > 0 else 0.0))
         self.stall = float(cfg.get("stall", 0.0))
         self.stall_ms = float(cfg.get("stall_ms", 1000.0))
+        self.refuse = float(cfg.get("refuse", 0.0))
         self._rng = random.Random(int(cfg.get("seed", 0)))
         self._lock = threading.Lock()
+        # kill_member=<addr|idx>[@s] — one-shot federation process kill.
+        self._kill_target: Optional[str] = None
+        self._kill_at_s = 0.0
+        self._kill_fired = False
+        km = cfg.get("kill_member")
+        if km:
+            target, _, at = str(km).partition("@")
+            self._kill_target = target.strip()
+            if at:
+                try:
+                    self._kill_at_s = float(at)
+                except ValueError:
+                    self._kill_at_s = 0.0
+            else:
+                # Seeded default: same spec, same kill time, every run.
+                self._kill_at_s = 0.5 + self._rng.random()
         # poison=<run_id>[@<turn>] — one-shot fleet popcount poison.
         self._poison_run: Optional[str] = None
         self._poison_turn = 0
@@ -176,6 +205,35 @@ class ChaosInjector:
         else:
             time.sleep(self.delay_ms / 1000.0)
 
+    def on_dial(self, addr) -> None:
+        """Called by client dial sites before connect(). The refuse
+        draw happens only when armed, so specs without `refuse` keep
+        their exact historical fault sequences."""
+        if self.refuse <= 0.0:
+            return
+        with self._lock:
+            r = self._rng.random()
+        if r < self.refuse:
+            _INJECTED["refuse"].inc()
+            raise ConnectionRefusedError(f"chaos: refused dial to {addr}")
+
+    def take_kill_member(self, addr: str, idx: int,
+                         elapsed_s: float) -> bool:
+        """True exactly once, when the armed member (by address or
+        index) is polled at/after the armed elapsed time."""
+        if self._kill_target is None or self._kill_fired:
+            return False
+        if elapsed_s < self._kill_at_s:
+            return False
+        if self._kill_target not in (addr, str(idx)):
+            return False
+        with self._lock:
+            if self._kill_fired:
+                return False
+            self._kill_fired = True
+        _INJECTED["kill_member"].inc()
+        return True
+
     def take_poison(self, run_id: str, turn: int) -> bool:
         """True exactly once, when the armed run reaches the armed turn."""
         if self._poison_run is None or self._poison_fired:
@@ -234,3 +292,16 @@ def recv_hook(sock) -> None:
 def take_poison(run_id: str, turn: int) -> bool:
     inj = injector()
     return False if inj is None else inj.take_poison(run_id, turn)
+
+
+def dial_hook(addr) -> None:
+    inj = injector()
+    if inj is not None:
+        inj.on_dial(addr)
+
+
+def take_kill_member(addr: str, idx: int, elapsed_s: float) -> bool:
+    inj = injector()
+    if inj is None:
+        return False
+    return inj.take_kill_member(addr, idx, elapsed_s)

@@ -67,7 +67,19 @@ METHOD_RETRY_BUDGETS = {"Ping": 0, "KillProg": 0}
 MUTATING_METHODS = frozenset({
     "CreateRun", "DestroyRun", "SetRule", "Checkpoint", "CFput",
     "DrainFlags", "RestoreRun", "AbortRun", "Profile", "KillProg",
+    "AdoptRun",
 })
+
+
+def _dial(addr, timeout):
+    """socket.create_connection behind the chaos dial hook: when
+    GOL_CHAOS arms `refuse=p` the hook raises ConnectionRefusedError
+    before the kernel ever dials, so dial-retry attribution can be
+    exercised deterministically."""
+    if wire._chaos_enabled():
+        from gol_tpu import chaos
+        chaos.dial_hook(f"{addr[0]}:{addr[1]}")
+    return socket.create_connection(addr, timeout=timeout)
 
 
 def _transport_error(msg: str, kind: str) -> ConnectionError:
@@ -187,8 +199,7 @@ class RemoteEngine:
         with trace.span(f"rpc.{label}"):
             try:
                 try:
-                    sock = socket.create_connection(
-                        self._addr, timeout=self._timeout)
+                    sock = _dial(self._addr, self._timeout)
                 except (socket.timeout, TimeoutError) as e:
                     raise _transport_error(
                         f"connect timeout to {addr} after "
@@ -265,7 +276,30 @@ class RemoteEngine:
         hb_interval = env_float(HB_INTERVAL_ENV, HB_INTERVAL_DEFAULT)
         hb_misses = env_int(HB_MISSES_ENV, HB_MISSES_DEFAULT)
 
-        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        # Dial failures get the same .rpc_error_kind attribution as
+        # _call_once: the blocking run call is never retried here, but
+        # the distributor's lost-engine recovery (and a federation
+        # router fronting this address) keys member exclusion off the
+        # kind tag, so an unreachable member must not surface as an
+        # anonymous OSError.
+        addr_s = f"{self._addr[0]}:{self._addr[1]}"
+        try:
+            sock = _dial(self._addr, self._timeout)
+        except (socket.timeout, TimeoutError) as e:
+            obs.CLIENT_ERRORS.labels(method="ServerDistributor").inc()
+            raise _transport_error(
+                f"connect timeout to {addr_s} after {self._timeout}s "
+                f"(ServerDistributor): {e}", "timeout") from e
+        except ConnectionRefusedError as e:
+            obs.CLIENT_ERRORS.labels(method="ServerDistributor").inc()
+            raise _transport_error(
+                f"connect refused by {addr_s} (ServerDistributor): {e}",
+                "refused") from e
+        except OSError as e:
+            obs.CLIENT_ERRORS.labels(method="ServerDistributor").inc()
+            raise _transport_error(
+                f"connect to {addr_s} failed (ServerDistributor): {e}",
+                "refused") from e
         wire.enable_nodelay(sock)
         # The run socket is idle for the whole (possibly multi-hour) run;
         # without keepalive a NAT/firewall can evict the flow while fresh

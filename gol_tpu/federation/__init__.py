@@ -1,0 +1,19 @@
+"""Fleet federation (PR 12): membership, router tier, member failover.
+
+`registry` — the router's member registry (heartbeat-stamped census);
+`hrw` — rendezvous placement on run_id; `router` — the proxy tier with
+HRW CreateRun placement, ListRuns fan-out, transparent byte relay, a
+router-side req_id dedupe window, and dead-member run adoption through
+`FleetEngine.adopt_run`; `agent` — the member-side heartbeat thread
+(`server.py --federate`). See docs/ARCHITECTURE.md §Federation &
+failover.
+"""
+
+from gol_tpu.federation.agent import FederationAgent  # noqa: F401
+from gol_tpu.federation.hrw import place, rank, score  # noqa: F401
+from gol_tpu.federation.registry import (  # noqa: F401
+    MemberRegistry,
+    dead_after_s,
+    heartbeat_interval_s,
+)
+from gol_tpu.federation.router import FederationRouter  # noqa: F401

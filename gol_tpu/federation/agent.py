@@ -1,0 +1,91 @@
+"""Member-side federation agent: register + heartbeat to the router.
+
+A daemon thread each federated fleet server starts (`server.py
+--federate ROUTER_ADDR`). Every `GOL_FED_HEARTBEAT` seconds it sends a
+`RegisterMember` message over the ordinary wire protocol carrying the
+member's advertised address, capacity, mesh geometry, and a strictly
+increasing sequence number. The router's registry is the only party
+that stamps time; the agent only promises the sequence is monotonic.
+
+Failures are soft by design: a beat that cannot reach the router is
+logged and dropped — the NEXT beat is the retry, and the member keeps
+serving its runs either way (the router declares death only after
+`GOL_FED_DEAD_AFTER` of silence). One request per connection, exactly
+like every other wire RPC.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from gol_tpu import wire
+from gol_tpu.federation.registry import heartbeat_interval_s
+from gol_tpu.obs.log import log as obs_log
+
+
+class FederationAgent:
+    """Heartbeat loop from one member to one router."""
+
+    def __init__(self, router_addr: str, address: str,
+                 capacity: int = 0, mesh: Optional[dict] = None,
+                 timeout: float = 5.0) -> None:
+        host, _, port = router_addr.rpartition(":")
+        self._router = (host or "127.0.0.1", int(port))
+        self.address = address          # advertised; doubles as member_id
+        self.capacity = int(capacity)
+        self.mesh = mesh
+        self._timeout = float(timeout)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat_once(self) -> Optional[dict]:
+        """One RegisterMember round trip; None if the router was
+        unreachable (the caller's loop just waits for the next beat)."""
+        self._seq += 1
+        header = {
+            "method": "RegisterMember",
+            "member_id": self.address,
+            "address": self.address,
+            "capacity": self.capacity,
+            "seq": self._seq,
+        }
+        if self.mesh is not None:
+            header["mesh"] = self.mesh
+        try:
+            with socket.create_connection(
+                    self._router, timeout=self._timeout) as sock:
+                sock.settimeout(self._timeout)
+                wire.enable_nodelay(sock)
+                wire.send_msg(sock, header)
+                resp, _ = wire.recv_msg(sock)
+            return resp
+        except (OSError, ConnectionError, wire.WireProtocolError) as e:
+            obs_log("fed.heartbeat_failed", level="warning",
+                    member=self.address,
+                    error=f"{type(e).__name__}: {e}")
+            return None
+
+    def _run(self) -> None:
+        first = True
+        while not self._stop.is_set():
+            resp = self.beat_once()
+            if first and resp is not None:
+                obs_log("fed.registered", member=self.address,
+                        live=resp.get("live"))
+                first = False
+            self._stop.wait(heartbeat_interval_s())
+
+    def start(self) -> "FederationAgent":
+        self._thread = threading.Thread(
+            target=self._run, name="gol-fed-agent", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)

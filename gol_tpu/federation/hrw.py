@@ -1,0 +1,48 @@
+"""Rendezvous (highest-random-weight) placement for the router tier.
+
+Each (member, run_id) pair gets a deterministic 64-bit score from a
+SHA-256 digest; a run lives on the live member with the highest score.
+The HRW property the federation leans on: removing one member of N
+moves ONLY the runs that member owned (every other run's argmax is
+unchanged), and adding one moves only the ~1/(N+1) of runs whose new
+score beats their old owner's — no mass reshuffle on membership churn,
+which is what keeps failover adoption proportional to the dead
+member's share of the fleet. `tests/test_federation.py` pins both
+directions on a fixed run-id corpus.
+
+Scores are keyed on member_id (the member's advertised address), so
+every router instance — and a restarted router with an empty placement
+map — computes identical placements from the same membership view.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Optional
+
+
+def score(member_id: str, run_id: str) -> int:
+    """Deterministic 64-bit rendezvous weight for one (member, run)."""
+    digest = hashlib.sha256(
+        f"{member_id}|{run_id}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def rank(run_id: str, member_ids: Iterable[str]) -> List[str]:
+    """Members ordered by descending rendezvous score for `run_id`.
+    The head is the placement; the tail is the failover/exclusion
+    order (ties broken by member_id so the order is total)."""
+    return sorted(set(member_ids),
+                  key=lambda m: (score(m, run_id), m), reverse=True)
+
+
+def place(run_id: str, member_ids: Iterable[str]) -> Optional[str]:
+    """The highest-scoring member for `run_id`, or None if empty."""
+    best = None
+    best_key = None
+    for m in set(member_ids):
+        key = (score(m, run_id), m)
+        if best_key is None or key > best_key:
+            best_key = key
+            best = m
+    return best

@@ -353,6 +353,76 @@ class FleetEngine(ControlFlagProtocol):
         obs.RUNS_DESTROYED.inc()
         return rec
 
+    def adopt_run(self, run_id: str, ckpt_every: int = 0,
+                  target_turn: Optional[int] = None) -> dict:
+        """Adopt a dead federation member's run from its per-run
+        checkpoint directory under the shared GOL_CKPT root.
+
+        Only the newest durable MANIFEST is read here — geometry, rule,
+        and turn; the payload itself stays untrusted. The run is
+        admitted against the local budget and registered directly in
+        state "quarantined", so the PR-10 quarantine machinery performs
+        the capped-backoff, integrity-verified restore and re-queues it
+        for placement — adoption moves only checkpoint bytes and route
+        state, never live board traffic."""
+        from gol_tpu.ckpt import manifest as mf
+
+        self._check_alive()
+        rid = str(run_id or "")
+        if not valid_run_id(rid) or rid == LEGACY_RUN_ID:
+            self.admission.reject("run_id")
+            raise RuntimeError("admission rejected: run_id")
+        base = os.environ.get(CKPT_ENV, "")
+        if not base:
+            raise RuntimeError(
+                "checkpointing not configured (set GOL_CKPT)")
+        directory = self._ckpt_dir(rid, base)
+        latest = mf.latest_checkpoint(directory)
+        if latest is None:
+            raise FileNotFoundError(
+                f"no durable checkpoint for run {rid} in {directory}")
+        m = latest[2]
+        board_meta = m.get("board")
+        if not board_meta:
+            raise RuntimeError(
+                f"manifest for run {rid} carries no board dims")
+        h_, w_ = int(board_meta["h"]), int(board_meta["w"])
+        run_rule = self._resolve_rule(m.get("rule"))
+        size = choose_bucket_size(h_, w_, self.bucket_sizes)
+        if size is None:
+            self.admission.reject("shape")
+            raise RuntimeError(
+                "admission rejected: shape (board sides must divide a "
+                f"bucket class {self.bucket_sizes})")
+        cost = run_cost(size, size // WORD_BITS)
+        handle = RunHandle(rid, run_rule, h_, w_,
+                           ckpt_every=int(ckpt_every),
+                           target_turn=target_turn,
+                           start_turn=int(m["turn"]))
+        handle.bucket_key = (size, size, run_rule.rulestring)
+        handle.admitted_cost = cost
+        # Born quarantined: no trusted board yet. The fleet loop's
+        # restore path verifies + loads the checkpoint and queues the
+        # run for placement; h.adopted routes the outcome to
+        # gol_fed_adopted_runs_total.
+        handle.state = "quarantined"
+        handle.quarantine_reason = "restore"
+        handle.adopted = True
+        with self._fleet_lock:
+            if rid in self._runs:
+                self.admission.reject("run_id")
+                raise RuntimeError("admission rejected: run_id")
+            ok, reason = self.admission.try_admit(cost)
+            if not ok:
+                self.admission.reject(reason or "unknown")
+                raise RuntimeError(f"admission rejected: {reason}")
+            self._runs[rid] = handle
+            self._wake.notify_all()
+        obs_log("fleet.adopt", run_id=rid, turn=handle.turn,
+                rule=run_rule.rulestring, board=f"{h_}x{w_}")
+        self._ensure_loop()
+        return handle.describe()
+
     def set_rule(self, run_id: str, rule) -> dict:
         """Migrate a fleet run to a new life-like rule WITHOUT dropping
         its board: the run is evicted from its current bucket (an exact
@@ -1469,6 +1539,9 @@ class FleetEngine(ControlFlagProtocol):
             if h.quarantine_tries >= max_tries:
                 obs_log("fleet.quarantine_terminal", level="error",
                         run_id=h.run_id, tries=h.quarantine_tries)
+                if h.adopted:
+                    h.adopted = False
+                    obs.FED_ADOPTED_RUNS.labels(status="error").inc()
                 h.done.set()  # drivers must not wait on a dead run
             return
         h.frozen = board01
@@ -1480,6 +1553,9 @@ class FleetEngine(ControlFlagProtocol):
         h.state = "queued"
         self._placeq.append(h)
         obs.RUNS_QUARANTINE_RESTORES.labels(status="ok").inc()
+        if h.adopted:
+            h.adopted = False
+            obs.FED_ADOPTED_RUNS.labels(status="ok").inc()
         obs_log("fleet.quarantine_restored", run_id=h.run_id,
                 turn=h.turn, attempt=h.quarantine_tries,
                 reason=h.quarantine_reason)

@@ -75,6 +75,7 @@ class RunHandle:
         "done", "created_s", "pending_seed", "abort",
         "admitted_cost", "enqueued_s", "advanced_s",
         "quarantine_reason", "quarantine_tries", "quarantine_next_s",
+        "adopted",
     )
 
     def __init__(self, run_id: str, rule, h: int, w: int,
@@ -129,6 +130,10 @@ class RunHandle:
         self.quarantine_reason: Optional[str] = None
         self.quarantine_tries = 0
         self.quarantine_next_s = 0.0
+        # Federation (PR 12): True while this handle is an adopted run
+        # whose first restore hasn't resolved yet — the quarantine
+        # service meters its outcome under gol_fed_adopted_runs_total.
+        self.adopted = False
 
     @property
     def active(self) -> bool:

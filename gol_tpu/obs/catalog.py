@@ -19,6 +19,7 @@ WIRE_METHODS = (
     "CFput", "DrainFlags", "KillProg", "Ping", "Stats", "AbortRun",
     "GetMetrics", "Checkpoint", "RestoreRun", "Profile",
     "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "SetRule",
+    "RegisterMember", "AdoptRun",
     "unknown",
 )
 
@@ -184,7 +185,8 @@ def method_label(method: str) -> str:
 
 # Closed kind sets, pre-seeded like the wire methods so the resilience
 # families are visible at zero before the first fault.
-CHAOS_KINDS = ("drop", "delay", "truncate", "corrupt", "stall")
+CHAOS_KINDS = ("drop", "delay", "truncate", "corrupt", "stall",
+               "kill_member", "refuse")
 RPC_ERROR_KINDS = ("timeout", "refused", "reset", "protocol")
 
 CHAOS_INJECTED = REGISTRY.counter(
@@ -193,8 +195,10 @@ CHAOS_INJECTED = REGISTRY.counter(
     "(gol_tpu/chaos.py), by kind: drop (socket closed instead of the "
     "operation), delay (bounded sleep), truncate (partial header then "
     "close), corrupt (one header byte zeroed so the peer sees a "
-    "protocol error), stall (long sleep that outlasts read timeouts). "
-    "Stays 0 unless GOL_CHAOS is set.",
+    "protocol error), stall (long sleep that outlasts read timeouts), "
+    "refuse (dial-time ConnectionRefusedError before the socket "
+    "connects), kill_member (process-level SIGKILL of a federation "
+    "member at a seeded time). Stays 0 unless GOL_CHAOS is set.",
     label_names=("kind",))
 for _k in CHAOS_KINDS:
     CHAOS_INJECTED.labels(kind=_k)
@@ -403,6 +407,59 @@ for _k in RPC_KINDS:
 for _q in SLO_QUANTILES:
     FLEET_QUEUE_WAIT_MS.labels(q=_q)
     FLEET_STALENESS_MS.labels(q=_q)
+
+
+# ------------------------------------------------------- fleet federation
+
+# Member lifecycle states the router's registry distinguishes. Closed
+# set, pre-seeded like every other resilience family.
+FED_MEMBER_STATES = ("live", "dead")
+
+FED_MEMBERS = REGISTRY.gauge(
+    "gol_fed_members",
+    "Fleet servers known to the federation router's member registry, by "
+    "state: live (heartbeat within GOL_FED_DEAD_AFTER) or dead "
+    "(heartbeats lapsed; its runs are being adopted by survivors). A "
+    "re-registering dead member moves back to live.",
+    label_names=("state",))
+for _s in FED_MEMBER_STATES:
+    FED_MEMBERS.labels(state=_s)
+
+FED_HEARTBEAT_AGE_MS = REGISTRY.gauge(
+    "gol_fed_heartbeat_age_ms",
+    "Heartbeat age quantiles in milliseconds across live members at the "
+    "router's last registry sweep (now - last heartbeat stamp). Climbs "
+    "toward GOL_FED_DEAD_AFTER*1000 as a member goes quiet.",
+    label_names=("q",))
+for _q in SLO_QUANTILES:
+    FED_HEARTBEAT_AGE_MS.labels(q=_q)
+
+FED_FAILOVERS = REGISTRY.counter(
+    "gol_fed_failovers_total",
+    "Members declared dead by the router's heartbeat sweep (each "
+    "declaration triggers adoption of the member's placed runs by "
+    "rendezvous re-placement over the survivors).")
+
+FED_ADOPTED_RUNS = REGISTRY.counter(
+    "gol_fed_adopted_runs_total",
+    "Runs adopted from a dead member via its shared-root per-run "
+    "checkpoints, by outcome: ok (the adopting member verified and "
+    "restored the manifest, run re-queued for placement), error "
+    "(adoption RPC failed or the restore exhausted its quarantine "
+    "budget).",
+    label_names=("status",))
+for _s in ("ok", "error"):
+    FED_ADOPTED_RUNS.labels(status=_s)
+
+FED_ROUTER_OVERHEAD_MS = REGISTRY.gauge(
+    "gol_fed_router_overhead_ms",
+    "Router proxy overhead quantiles in milliseconds: client-facing "
+    "wall time of a proxied RPC minus the member-facing round trip "
+    "(relay framing + placement lookup + dedupe bookkeeping), from a "
+    "log-bucket estimator flushed at the SLO cadence.",
+    label_names=("q",))
+for _q in SLO_QUANTILES:
+    FED_ROUTER_OVERHEAD_MS.labels(q=_q)
 
 
 # ------------------------------------------------- tracing / flight recorder

@@ -61,6 +61,16 @@ def healthz_doc() -> dict:
     # single-run engines.
     doc["slo"] = obs_slo.fleet_health()
     doc.update(devstats.healthz_fields())
+    # Federation member/peer table (PR 12): present only in a process
+    # running a router registry. Lazy import + cached-reference read —
+    # same no-lock discipline as the fleet health document.
+    try:
+        from gol_tpu.federation import registry as fed_registry
+        fed = fed_registry.active_doc()
+    except Exception:  # noqa: BLE001 — /healthz must never 500
+        fed = None
+    if fed is not None:
+        doc["federation"] = fed
     return doc
 
 

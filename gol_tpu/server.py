@@ -104,6 +104,7 @@ class EngineServer:
     MUTATING_METHODS = frozenset({
         "CreateRun", "DestroyRun", "SetRule", "Checkpoint", "CFput",
         "DrainFlags", "RestoreRun", "AbortRun", "Profile", "KillProg",
+        "AdoptRun",
     })
 
     def serve_forever(self) -> None:
@@ -531,6 +532,26 @@ class EngineServer:
                     str(header.get("run_id") or ""),
                     str(header.get("rule") or ""))
                 self._reply(conn, {"ok": True, "run": rec})
+            elif method == "AdoptRun":
+                # Federation failover (PR 12): adopt a dead member's
+                # run from its per-run manifests under the shared
+                # GOL_CKPT root. Fleet engines register the run in
+                # quarantine so the capped-backoff verified restore
+                # machinery re-homes it; others answer FleetUnsupported.
+                from gol_tpu.fleet.handles import FleetUnsupported
+
+                adopt = getattr(self.engine, "adopt_run", None)
+                if adopt is None:
+                    raise FleetUnsupported(
+                        f"{type(self.engine).__name__} serves a single "
+                        "run; start the server with --fleet for "
+                        "AdoptRun")
+                tt = header.get("target_turn")
+                rec = adopt(
+                    str(header.get("run_id") or ""),
+                    ckpt_every=int(header.get("ckpt_every", 0) or 0),
+                    target_turn=int(tt) if tt is not None else None)
+                self._reply(conn, {"ok": True, "run": rec})
             elif method == "RestoreRun":
                 turn = self._restore_run(str(header.get("path", "")))
                 self._reply(conn, {"ok": True, "turn": turn})
@@ -693,6 +714,17 @@ def main() -> None:
                          "legacy single run, bit-identically; life-like "
                          "rules only; GOL_FLEET_BUCKETS/GOL_FLEET_CHUNK/"
                          "GOL_FLEET_MEM_BUDGET tune it)")
+    ap.add_argument("--federate", metavar="ROUTER_ADDR", default="",
+                    help="join a federation: register with the router "
+                         "at host:port and heartbeat every "
+                         "GOL_FED_HEARTBEAT seconds (the router "
+                         "declares this member dead after "
+                         "GOL_FED_DEAD_AFTER of silence and re-homes "
+                         "its runs from the shared GOL_CKPT root)")
+    ap.add_argument("--advertise", metavar="HOST", default="127.0.0.1",
+                    help="hostname the router should dial this member "
+                         "back on (default 127.0.0.1; the port is the "
+                         "bound serving port)")
     args = ap.parse_args()
     if args.fleet and args.sparse:
         ap.error("--fleet and --sparse are mutually exclusive")
@@ -814,12 +846,22 @@ def main() -> None:
 
         msrv = start_metrics_server(args.metrics_port)
         print(f"metrics on {msrv.url}", flush=True)
+    agent = None
+    if args.federate:
+        from gol_tpu.federation.agent import FederationAgent
+
+        devices = len(np.atleast_1d(srv.engine._devices))
+        agent = FederationAgent(
+            args.federate, f"{args.advertise}:{srv.port}",
+            capacity=devices, mesh={"devices": devices}).start()
     # This exact banner is the readiness contract: harnesses parse
     # "serving on :<port>" from stdout to learn the bound port.
     print(f"gol_tpu engine serving on :{srv.port} "
           f"({len(np.atleast_1d(srv.engine._devices))} device(s), "
           f"rule {srv.engine._rule.rulestring})")
     srv.serve_forever()
+    if agent is not None:
+        agent.stop()
     # Orderly stop (accept loop closed, e.g. KillProg without the exit
     # timer): still export whatever spans were recorded.
     trace.export_from_env()

@@ -775,3 +775,115 @@ def recv_msg(sock: socket.socket,
             _BYTES_RECV.inc(tally.n)
     _MSGS_RECV.inc()
     return header, world
+
+
+# -- raw relay (federation router) ------------------------------------
+#
+# The router tier proxies RPCs without re-encoding them: it parses the
+# JSON header only to route (method, run_id, req_id) and to compute how
+# many payload bytes follow, then forwards every byte verbatim. That is
+# what keeps the PR-10 req_id dedupe semantics, the tc trace context,
+# and the negotiated codecs (including per-viewer xrle bases) intact
+# across the proxy — the member sees the client's exact bytes, and vice
+# versa. None of this touches send_msg/recv_msg: a routerless
+# single-server deployment stays byte-for-byte unchanged on the wire.
+
+def recv_head_raw(sock: socket.socket) -> Tuple[dict, bytes]:
+    """Receive one message's framed header WITHOUT consuming its board
+    payload: (parsed header, the raw framed bytes — 4-byte length
+    prefix + JSON exactly as the peer sent them). Pair with
+    `payload_nbytes` + `relay_payload` to stream the rest."""
+    tally = _Tally()
+    try:
+        (n,) = _LEN.unpack(_recv_exact(sock, 4, tally))
+        if n > MAX_HEADER:
+            raise WireProtocolError(f"header too large: {n}")
+        raw = _recv_exact(sock, n, tally)
+        try:
+            header = json.loads(raw)
+        except ValueError as e:
+            raise WireProtocolError(f"malformed header: {e}") from e
+        if not isinstance(header, dict):
+            raise WireProtocolError(
+                f"malformed header: expected object, "
+                f"got {type(header).__name__}")
+    finally:
+        if tally.n:
+            _BYTES_RECV.inc(tally.n)
+    _MSGS_RECV.inc()
+    return header, _LEN.pack(n) + raw
+
+
+def payload_nbytes(header: dict) -> int:
+    """Board-payload byte count implied by a received header (0 when it
+    carries no `world` meta). Mirrors recv_msg's framing rules — same
+    dim bounds, same per-codec size envelope — without decoding, so a
+    relay can refuse malformed framing exactly where recv_msg would."""
+    meta = header.get("world")
+    if meta is None:
+        return 0
+    try:
+        h = int(meta["h"])
+        w = int(meta["w"])
+    except (TypeError, KeyError, ValueError) as e:
+        raise WireProtocolError(f"malformed world dims: {e}") from e
+    if h <= 0 or w <= 0 or h * w > max_board_cells():
+        raise WireProtocolError(f"board dims out of bounds: {h}x{w}")
+    codec = meta.get("codec", CODEC_U8)
+    if codec == CODEC_U8 and "nbytes" not in meta:
+        return h * w  # legacy raw-u8 peer
+    if codec not in CODECS:
+        raise WireProtocolError(f"unknown codec: {codec!r}")
+    try:
+        nbytes = int(meta["nbytes"])
+    except (TypeError, KeyError, ValueError) as e:
+        raise WireProtocolError(f"malformed frame size: {e}") from e
+    wp = words(w)
+    lo, hi = {
+        CODEC_U8: (h * w, h * w),
+        CODEC_PACKED: (h * wp * 4, h * wp * 4),
+        CODEC_U8_ZLIB: (1, h * w - 1),
+        CODEC_PACKED_ZLIB: (1, h * wp * 4 - 1),
+        CODEC_XRLE: (0, h * w - 1),
+    }[codec]
+    if not lo <= nbytes <= hi:
+        raise WireProtocolError(
+            f"frame size out of bounds for {codec}: {nbytes} "
+            f"(board {h}x{w})")
+    return nbytes
+
+
+def send_raw(sock: socket.socket, raw: bytes) -> None:
+    """Put already-framed message bytes on the wire verbatim."""
+    sock.sendall(raw)
+    _BYTES_SENT.inc(len(raw))
+    _MSGS_SENT.inc()
+
+
+def frame_header(header: dict) -> bytes:
+    """Frame a header dict exactly as send_msg would (length prefix +
+    JSON), for relays that must rewrite one field (e.g. the router
+    stamping a generated run_id into CreateRun) before forwarding."""
+    raw = json.dumps(header).encode()
+    if len(raw) > MAX_HEADER:
+        raise WireProtocolError(f"header too large: {len(raw)}")
+    return _LEN.pack(len(raw)) + raw
+
+
+def relay_payload(src: socket.socket, dst: socket.socket,
+                  nbytes: int, chunk: int = 1 << 20) -> None:
+    """Stream exactly `nbytes` of payload from src to dst, verbatim."""
+    left = int(nbytes)
+    moved = 0
+    try:
+        while left:
+            buf = src.recv(min(left, chunk))
+            if not buf:
+                raise ConnectionError("peer closed mid-payload")
+            dst.sendall(buf)
+            left -= len(buf)
+            moved += len(buf)
+    finally:
+        if moved:
+            _BYTES_RECV.inc(moved)
+            _BYTES_SENT.inc(moved)

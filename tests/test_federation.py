@@ -1,0 +1,233 @@
+"""Federation unit tests (PR 12): HRW placement stability and the
+router's req_id dedupe window across a member failover.
+
+The router tests run against stub members — tiny wire-protocol TCP
+servers that count invocations — so they exercise ROUTER semantics
+(placement, relay, dedupe, adoption) without jax or a fleet engine:
+the end-to-end path with real fleet servers is tools/federation_smoke.
+"""
+
+from __future__ import annotations
+
+import collections
+import socket
+import threading
+import time
+
+import pytest
+
+from gol_tpu import wire
+from gol_tpu.federation import hrw
+from gol_tpu.federation.router import FederationRouter
+
+CORPUS = [f"run-{i:03d}" for i in range(200)]
+MEMBERS3 = ["10.0.0.1:8799", "10.0.0.2:8799", "10.0.0.3:8799"]
+
+
+# --------------------------------------------------------------- HRW
+
+def test_hrw_place_deterministic_and_order_free():
+    for rid in CORPUS[:20]:
+        owner = hrw.place(rid, MEMBERS3)
+        assert owner in MEMBERS3
+        assert hrw.place(rid, list(reversed(MEMBERS3))) == owner
+        assert hrw.rank(rid, MEMBERS3)[0] == owner
+
+
+def test_hrw_removal_moves_only_the_dead_members_runs():
+    """Removing 1 of N re-homes exactly the removed member's runs;
+    every other placement is untouched — the property that makes
+    failover adoption surgical instead of a full reshuffle."""
+    before = {rid: hrw.place(rid, MEMBERS3) for rid in CORPUS}
+    dead = MEMBERS3[1]
+    survivors = [m for m in MEMBERS3 if m != dead]
+    after = {rid: hrw.place(rid, survivors) for rid in CORPUS}
+    moved = {rid for rid in CORPUS if after[rid] != before[rid]}
+    assert moved == {rid for rid in CORPUS if before[rid] == dead}
+    # The corpus actually exercised all three members.
+    assert len(set(before.values())) == 3
+
+
+def test_hrw_addition_moves_only_about_one_in_n_plus_one():
+    """Adding a member steals only the runs it now wins — roughly
+    1/(N+1) of the corpus — and every stolen run lands ON the new
+    member."""
+    before = {rid: hrw.place(rid, MEMBERS3) for rid in CORPUS}
+    grown = MEMBERS3 + ["10.0.0.4:8799"]
+    after = {rid: hrw.place(rid, grown) for rid in CORPUS}
+    moved = {rid for rid in CORPUS if after[rid] != before[rid]}
+    assert all(after[rid] == "10.0.0.4:8799" for rid in moved)
+    # Expected share 25% of 200; generous binomial bounds.
+    assert 0.10 <= len(moved) / len(CORPUS) <= 0.45
+
+
+def test_hrw_empty_and_single_member():
+    assert hrw.place("r", []) is None
+    assert hrw.place("r", ["only:1"]) == "only:1"
+
+
+# ------------------------------------------------- router stub fleet
+
+class StubMember:
+    """A wire-protocol TCP server that answers everything ok and
+    counts method invocations — a member as the ROUTER sees one."""
+
+    def __init__(self):
+        self.calls = collections.Counter()
+        self.req_ids = []
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.address = f"127.0.0.1:{self._sock.getsockname()[1]}"
+        self._closed = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            header, _ = wire.recv_msg(conn)
+            method = str(header.get("method"))
+            self.calls[method] += 1
+            if header.get("req_id"):
+                self.req_ids.append((method, header["req_id"]))
+            rid = header.get("run_id", "r")
+            if method in ("CreateRun", "AdoptRun"):
+                wire.send_msg(conn, {
+                    "ok": True,
+                    "run": {"run_id": rid, "state": "running",
+                            "turn": 0, "served_by": self.address}})
+            elif method == "ListRuns":
+                wire.send_msg(conn, {"ok": True, "runs": []})
+            else:
+                wire.send_msg(conn, {"ok": True, "turn": 0})
+        except (ConnectionError, OSError, wire.WireProtocolError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _call(port, header):
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.settimeout(10)
+        wire.send_msg(s, header)
+        resp, _ = wire.recv_msg(s)
+    return resp
+
+
+@pytest.fixture()
+def cluster(monkeypatch):
+    """Router + two stub members with a test-driven heartbeat."""
+    monkeypatch.setenv("GOL_FED_HEARTBEAT", "0.1")
+    monkeypatch.setenv("GOL_FED_DEAD_AFTER", "0.4")
+    monkeypatch.setenv("GOL_FED_REROUTE", "5")
+    stubs = [StubMember(), StubMember()]
+    router = FederationRouter(port=0).start_background()
+    beating = {s.address: True for s in stubs}
+    stop = threading.Event()
+
+    def beat():
+        seq = 0
+        while not stop.is_set():
+            seq += 1
+            for s in stubs:
+                if beating[s.address]:
+                    router.registry.register(s.address, s.address, seq)
+            stop.wait(0.1)
+
+    t = threading.Thread(target=beat, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline \
+            and router.registry.members_doc()["live"] < 2:
+        time.sleep(0.02)
+    assert router.registry.members_doc()["live"] == 2
+    try:
+        yield router, stubs, beating
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        router.shutdown()
+        for s in stubs:
+            s.close()
+
+
+def test_router_places_on_hrw_owner_and_dedupes(cluster):
+    router, stubs, _ = cluster
+    by_addr = {s.address: s for s in stubs}
+    owner = by_addr[hrw.place("dup1", [s.address for s in stubs])]
+    header = {"method": "CreateRun", "run_id": "dup1", "h": 64,
+              "w": 64, "ckpt_every": 4, "req_id": "req-dup1"}
+    first = _call(router.port, dict(header))
+    assert first["ok"] and first["run"]["served_by"] == owner.address
+    assert owner.calls["CreateRun"] == 1
+    # Same req_id again: replayed from the router's window — the
+    # member must NOT see a second CreateRun.
+    second = _call(router.port, dict(header))
+    assert second == first
+    assert owner.calls["CreateRun"] == 1
+
+
+def test_router_dedupe_survives_member_failover(cluster):
+    """A retried mutate whose first attempt committed on a member that
+    DIED in between is answered from the router's recorded reply — the
+    surviving member never re-executes it."""
+    router, stubs, beating = cluster
+    by_addr = {s.address: s for s in stubs}
+    owner = by_addr[hrw.place("fo1", [s.address for s in stubs])]
+    survivor = next(s for s in stubs if s is not owner)
+    header = {"method": "CreateRun", "run_id": "fo1", "h": 64,
+              "w": 64, "ckpt_every": 4, "req_id": "req-fo1"}
+    first = _call(router.port, dict(header))
+    assert first["run"]["served_by"] == owner.address
+
+    # Kill the owner: stop its heartbeat and its socket; the sweeper
+    # must declare it dead and adopt fo1 onto the survivor.
+    beating[owner.address] = False
+    owner.close()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and survivor.calls["AdoptRun"] < 1:
+        time.sleep(0.05)
+    assert survivor.calls["AdoptRun"] == 1
+    assert router.registry.get(owner.address).state == "dead"
+
+    # The retry crosses the failover: recorded-reply replay, byte-for
+    # -byte the first answer, with zero re-execution anywhere.
+    retried = _call(router.port, dict(header))
+    assert retried == first
+    assert survivor.calls["CreateRun"] == 0
+
+    # A FRESH mutate for the adopted run routes to the survivor.
+    fresh = _call(router.port, {"method": "CreateRun", "run_id": "fo2",
+                                "h": 64, "w": 64, "ckpt_every": 0,
+                                "req_id": "req-fo2"})
+    assert fresh["run"]["served_by"] == survivor.address
+
+
+def test_router_lists_and_registers_members(cluster):
+    router, stubs, _ = cluster
+    resp = _call(router.port, {"method": "ListRuns"})
+    assert resp["ok"] and resp["runs"] == []
+    doc = router.registry.members_doc()
+    assert doc["live"] == 2 and doc["dead"] == 0
+    assert {m["member_id"] for m in doc["members"]} \
+        == {s.address for s in stubs}

@@ -1,0 +1,240 @@
+"""federation-smoke: prove the federated serving tier end to end on CPU.
+
+One acceptance scenario (PR 12), real member processes behind a real
+in-process router:
+
+  * three `--fleet --federate` servers register with a FederationRouter
+    and heartbeat; runs created THROUGH the router are HRW-placed over
+    the live members and driven to a parked target turn with per-run
+    manifests landing under one shared checkpoint root;
+  * one member (the owner of at least one run) is SIGKILLed: the
+    router's sweeper must declare it dead within GOL_FED_DEAD_AFTER,
+    meter the failover, and re-home its runs onto survivors through
+    AdoptRun -> FleetEngine.adopt_run (the PR-10 quarantine->restore
+    machinery, reading the dead member's run-<id>/ manifests);
+  * every run — adopted and undisturbed alike — must then be readable
+    through the SAME router address, parked at the SAME target turn,
+    bit-identical to a device torus replay of its seed;
+  * the registry families (gol_fed_members{state},
+    gol_fed_failovers_total) and the /healthz federation member table
+    must reflect exactly one death.
+
+Exit 0 = pass.
+
+    make federation-smoke   # bench.py --federation + gate, then this
+
+The member/router spawn helpers here are also imported by bench.py's
+--federation leg (same pattern as tools/load_smoke.py).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Tight failure-detection clock for tests/benches: sub-second beats, a
+# ~1 s death verdict, and a reroute window generous enough to cover an
+# adopting member's restore + recompile on a cold CPU host.
+FED_ENV = {
+    "GOL_FED_HEARTBEAT": "0.2",
+    "GOL_FED_DEAD_AFTER": "1.2",
+    "GOL_FED_REROUTE": "30",
+}
+
+
+def fail(msg: str) -> int:
+    print(f"federation-smoke: FAIL — {msg}", flush=True)
+    return 1
+
+
+def expected_board01(seed01: np.ndarray, turns: int) -> np.ndarray:
+    """{0,1} board after `turns` device torus turns — the parity
+    oracle (same packed stencil the fleet runs on, single board)."""
+    from gol_tpu.ops.bitpack import (
+        pack_np, packed_run_turns, unpack_np, words_bytes_np)
+
+    words = packed_run_turns(pack_np(seed01).view("<u4"), turns)
+    h, w = seed01.shape
+    return unpack_np(words_bytes_np(np.asarray(words)), h, w)
+
+
+def spawn_member(tmpdir, ckpt_root: str, router_port: int,
+                 ckpt_every: int = 4, extra_env=None):
+    """One federated fleet-server subprocess (checkpoints under the
+    SHARED root, heartbeating to the router). Returns the Popen; the
+    caller reads the bound port with `wait_member`."""
+    from tests.server_harness import spawn_server
+
+    env = dict(FED_ENV)
+    env.update(extra_env or {})
+    return spawn_server(
+        0, tmpdir, extra_env=env,
+        extra_args=("--fleet", "--checkpoint", ckpt_root,
+                    "--ckpt-every", str(ckpt_every),
+                    "--federate", f"127.0.0.1:{router_port}"))
+
+
+def wait_member(proc, timeout: float = 180.0):
+    """The member's advertised address ("127.0.0.1:<port>") once its
+    serving banner appears, or None."""
+    from tests.server_harness import wait_port
+
+    port = wait_port(proc, timeout=timeout)
+    return f"127.0.0.1:{port}" if port else None
+
+
+def wait_live(router, n: int, timeout: float = 60.0) -> bool:
+    """True once the router's registry counts `n` live members."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router.registry.members_doc().get("live", 0) >= n:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def wait_runs_at(cli, run_ids, turn: int, timeout: float = 300.0):
+    """Poll ListRuns through the router until every id is present at
+    >= `turn`; returns {run_id: member} or None on timeout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            runs, _ = cli.list_runs()
+        except Exception:
+            time.sleep(0.3)
+            continue
+        recs = {r["run_id"]: r for r in runs}
+        if all(rid in recs and recs[rid]["turn"] >= turn
+               for rid in run_ids):
+            return {rid: recs[rid]["member"] for rid in run_ids}
+        time.sleep(0.3)
+    return None
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("GOL_CHAOS", None)
+    os.environ.update(FED_ENV)
+
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.federation.router import FederationRouter
+    from gol_tpu.obs import catalog as obs
+    from gol_tpu.obs.http import healthz_doc
+
+    tmpdir = tempfile.mkdtemp(prefix="gol_fed_smoke_")
+    ckpt_root = os.path.join(tmpdir, "ck")
+    n_members, n_runs, target = 3, 6, 32
+    failovers0 = obs.FED_FAILOVERS.value
+
+    router = FederationRouter(port=0).start_background()
+    procs = [spawn_member(tmpdir, ckpt_root, router.port)
+             for _ in range(n_members)]
+    members = {}  # address -> proc
+    try:
+        for p in procs:
+            addr = wait_member(p)
+            if addr is None:
+                return fail("a member never announced its port")
+            members[addr] = p
+        if not wait_live(router, n_members):
+            return fail(f"registry never reached {n_members} live "
+                        f"members: {router.registry.members_doc()}")
+        print(f"federation-smoke: {n_members} members live behind "
+              f"router :{router.port}", flush=True)
+
+        cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=60.0)
+        rng = np.random.default_rng(12)
+        seeds = {}
+        for i in range(n_runs):
+            rid = f"fed{i}"
+            board = (rng.random((64, 64)) < 0.3).astype(np.uint8)
+            rec = cli.create_run(64, 64, board=board, run_id=rid,
+                                 ckpt_every=4, target_turn=target)
+            if rec["run_id"] != rid:
+                return fail(f"CreateRun echoed {rec['run_id']}")
+            seeds[rid] = board
+        owners = wait_runs_at(cli, seeds, target)
+        if owners is None:
+            return fail("runs never parked at their target turn")
+        spread = sorted(set(owners.values()))
+        print(f"federation-smoke: {n_runs} runs parked at turn "
+              f"{target} across {len(spread)} members", flush=True)
+
+        # Kill the member that owns fed0 (guaranteed at least one run).
+        victim = owners["fed0"]
+        victim_runs = sorted(r for r, m in owners.items()
+                             if m == victim)
+        os.kill(members[victim].pid, signal.SIGKILL)
+        members[victim].wait(10)
+        print(f"federation-smoke: SIGKILLed {victim} "
+              f"(owned {victim_runs})", flush=True)
+
+        # Survivors must adopt; every run must re-list and re-park.
+        owners2 = wait_runs_at(cli, seeds, target, timeout=240.0)
+        if owners2 is None:
+            return fail("runs never re-homed after the member kill")
+        for rid in victim_runs:
+            if owners2[rid] == victim:
+                return fail(f"{rid} still listed on the dead member")
+        doc = router.registry.members_doc()
+        if doc.get("live") != n_members - 1 or doc.get("dead") != 1:
+            return fail(f"registry census wrong after kill: {doc}")
+        if obs.FED_MEMBERS.labels(state="dead").value != 1:
+            return fail("gol_fed_members{state=dead} != 1")
+        if obs.FED_FAILOVERS.value - failovers0 < 1:
+            return fail("gol_fed_failovers_total never incremented")
+        hz = healthz_doc().get("federation")
+        if not hz or hz.get("dead") != 1 or len(hz["members"]) \
+                != n_members:
+            return fail(f"/healthz federation table wrong: {hz}")
+
+        # Parity: every run — adopted or undisturbed — bit-identical
+        # to the device torus replay of its seed, through the router.
+        for rid, seed in seeds.items():
+            bound = cli.for_run(rid)
+            board = turn = None
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                try:
+                    board, turn = bound.get_world()
+                except Exception:
+                    time.sleep(0.3)
+                    continue
+                if turn >= target:
+                    break
+                time.sleep(0.3)
+            if board is None or turn != target:
+                return fail(f"{rid}: no board at turn {target} "
+                            f"(got turn {turn})")
+            want = expected_board01(seed, target)
+            if not np.array_equal((board != 0).astype(np.uint8), want):
+                return fail(f"{rid}: post-failover board diverged "
+                            f"from the device replay oracle")
+        print(f"federation-smoke: all {n_runs} runs bit-identical at "
+              f"turn {target} after failover ({len(victim_runs)} "
+              f"adopted from {victim})", flush=True)
+        print("federation-smoke: PASS", flush=True)
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(10)
+        router.shutdown()
+
+
+if __name__ == "__main__":
+    rc = main()
+    # os._exit dodges the known XLA daemon-thread teardown abort;
+    # every gate already flushed its verdict.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

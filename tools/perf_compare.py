@@ -63,7 +63,8 @@ DEFAULT_MAX_REGRESSION = 10.0
 DEFAULT_GATE_PATTERN = (
     r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us"
     r"|rpc p\d+ ms|efficiency_pct|fleet_scaling_efficiency_pct"
-    r"|overlap_pct|availability_pct|retries_per_call")
+    r"|overlap_pct|availability_pct|retries_per_call"
+    r"|downtime_p\d+_ms|router_overhead_p\d+_ms")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
