@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke fuse-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -111,8 +111,18 @@ federation-smoke:
 	python tools/perf_compare.py BASELINE.json out/federation_smoke.jsonl
 	JAX_PLATFORMS=cpu python tools/federation_smoke.py
 
+# Temporal-fusion check, CPU-only: a reduced bench.py --fuse matrix
+# (k ∈ {1,4}, 512² dense + one 2-way mesh leg) run in-process, every
+# leg parity-gated bit-identical vs the k=1 torus replay, the analytic
+# per-turn halo observables checked against the physics (exchange
+# rounds/turn = 1/k, bytes/turn CONSERVED), registry families
+# validated, and the captured lines round-tripped through the
+# perf_compare gate (tools/fuse_smoke.py).
+fuse-smoke:
+	JAX_PLATFORMS=cpu python tools/fuse_smoke.py
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke fuse-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -655,6 +655,183 @@ def bench_mesh(ways=MESH_WAYS, turns: int = MESH_TURNS) -> int:
     return rc
 
 
+# --fuse leg sizing. The k sweep spans depth 1 (the plain-scan control
+# every fused leg is parity-gated against) through 16; turn counts are
+# multiples of 16 so no sweep point pays a remainder trim, and sized so
+# device compute dominates dispatch latency at each board. The mesh
+# legs reuse the --mesh board scale (1024², 2048 turns — a multiple of
+# every k) on 2/4-way meshes: the per-turn halo observables come from
+# the same analytic `halo_traffic` model the run path mirrors, so
+# "exchanges/turn drops k-fold, bytes/turn conserved" is gate-checkable
+# without a link probe.
+FUSE_KS = (1, 2, 4, 8, 16)
+FUSE_DENSE_TURNS = {512: 8192, 8192: 128, 131072: 16}
+FUSE_MESH_WAYS = (2, 4)
+FUSE_MESH_N = 1024
+FUSE_MESH_TURNS = 2048
+
+
+def bench_fuse(ks=FUSE_KS, sizes=None, turns_override: int = 0,
+               ways=FUSE_MESH_WAYS, mesh_turns: int = FUSE_MESH_TURNS,
+               ) -> int:
+    """Temporal-fusion legs (`--fuse`): a k-sweep of the fused macro-step
+    tier (`ops/fused.py`) on dense single-device boards plus 1-D mesh
+    legs, every leg parity-gated BIT-IDENTICAL against the k=1 torus
+    replay of the same board and turn count.
+
+    Gated metrics:
+
+    * cell-updates/sec (fused, k=N, board[, W-way]) — throughput of the
+      fused dispatch at pinned depth k (k=1 IS the plain scan control).
+    * halo exchanges/turn (fused, k=N, W-way) — analytic ppermute
+      exchange rounds per advanced turn: the latency-exposure count,
+      drops ~k-fold under fusion. Lower is better.
+    * halo bytes/turn (fused, k=N, W-way) — analytic halo bytes per
+      advanced turn. CONSERVED by fusion on the 1-D mesh (a k-deep
+      exchange ships 2k rows per k turns — the same 2 rows/turn), so
+      this entry gates flatness honestly rather than claiming a
+      reduction the physics doesn't allow. Lower is better.
+
+    CAVEAT on CPU hosts: the windowed jnp tier trades redundant margin
+    compute for cache residency; whether that wins depends on the
+    host's memory hierarchy, so best-k may be 1 — the sweep reports
+    what it measured and the gate holds each k to its own anchor."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.models.lifelike import CONWAY
+    from gol_tpu.ops.bitpack import pack, packed_run_turns
+    from gol_tpu.ops.fused import fuse_block_rows, fused_packed_run_turns
+    from gol_tpu.parallel.halo import (
+        fused_run_fn,
+        halo_traffic,
+        shard_board,
+        sharded_packed_run_turns,
+    )
+    from gol_tpu.parallel.mesh import make_mesh, mesh_geometry
+    from gol_tpu.utils.sync import wait
+
+    platform = jax.devices()[0].platform
+    rc = 0
+    sizes = tuple(sizes) if sizes else tuple(sorted(FUSE_DENSE_TURNS))
+    # k=1 first: its output is the parity reference for every other k.
+    ks = tuple(sorted(set(int(k) for k in ks)))
+
+    for n in sizes:
+        turns = turns_override or FUSE_DENSE_TURNS.get(n) or 64
+        mesh1 = make_mesh(1)
+        cells, _ = _dense_board(n, mesh1, packed=True, try_fixture=False)
+        ref = None
+        k1_cups = None
+        best_k, best_cups = None, 0.0
+        for k in ks:
+
+            def run(c, t, depth=k):
+                return fused_packed_run_turns(
+                    c, t, CONWAY, fuse=depth, platform=platform)
+
+            wait(run(cells, turns))  # compile + warm at the timed length
+            t0 = time.perf_counter()
+            out = run(cells, turns)
+            wait(out)
+            elapsed = time.perf_counter() - t0
+            if ref is None:
+                # First sweep point: materialize the k=1 replay
+                # reference (the k=1 leg's own output when 1 ∈ ks).
+                ref = out if k == 1 else packed_run_turns(
+                    cells, turns, CONWAY)
+                wait(ref)
+            parity = bool(jnp.array_equal(out, ref))
+            if not parity:
+                print(f"PARITY FAIL (fuse {n}x{n} k={k}): fused output "
+                      f"differs from the k=1 torus replay",
+                      file=sys.stderr)
+                rc = 1
+            cups = turns * n * n / elapsed
+            if k == 1:
+                k1_cups = cups
+            if cups > best_cups:
+                best_k, best_cups = k, cups
+            block = fuse_block_rows(n, n // 32, k) if k > 1 else 0
+            _emit(
+                f"cell-updates/sec (fused, k={k}, {n}x{n})",
+                round(cups, 1), "cell-updates/s", None,
+                {"size": n, "turns": turns, "k": k,
+                 "elapsed_s": round(elapsed, 4),
+                 "turns_per_s": round(turns / elapsed, 1),
+                 "block_rows": block, "platform": platform,
+                 "fused_path": ("plain-scan" if k <= 1 or block in
+                                (0, n) else "windowed"),
+                 "alive_parity": parity,
+                 "parity_check": f"{turns}-turn full-board equality vs "
+                                 f"k=1 torus replay"})
+        if k1_cups:
+            print(f"BENCH NOTE (fuse, {n}x{n}): best k={best_k} at "
+                  f"{best_cups:.3g} cups = {best_cups / k1_cups:.2f}x "
+                  f"the k=1 control", file=sys.stderr)
+
+    # ---- mesh legs: fused deep-halo exchange, per-turn observables
+    ndev = len(jax.devices())
+    usable = tuple(w for w in ways if 1 < w <= ndev)
+    skipped = tuple(w for w in ways if w > ndev)
+    if skipped:
+        print(f"BENCH NOTE (fuse mesh): skipping ways {skipped}: only "
+              f"{ndev} device(s)", file=sys.stderr)
+    n = FUSE_MESH_N
+    if usable:
+        rng = np.random.default_rng(7)
+        words = np.asarray(pack(
+            (rng.random((n, n)) < 0.25).astype(np.uint8)))
+        ref = None
+        for w in usable:
+            mesh = make_mesh(w)
+            cells = shard_board(jnp.asarray(words), mesh)
+            if ref is None:
+                ref = packed_run_turns(jnp.asarray(words), mesh_turns,
+                                       CONWAY)
+                wait(ref)
+            for k in ks:
+                runner = (fused_run_fn(k) if k > 1
+                          else sharded_packed_run_turns)
+                wait(runner(cells, mesh_turns, mesh))  # compile + warm
+                t0 = time.perf_counter()
+                out = runner(cells, mesh_turns, mesh)
+                wait(out)
+                elapsed = time.perf_counter() - t0
+                parity = bool(jnp.array_equal(out, ref))
+                if not parity:
+                    print(f"PARITY FAIL (fuse mesh {w}-way k={k}): "
+                          f"fused output differs from the k=1 torus "
+                          f"replay", file=sys.stderr)
+                    rc = 1
+                cups = mesh_turns * n * n / elapsed
+                traffic = halo_traffic("packed", tuple(cells.shape),
+                                       mesh, mesh_turns, fuse=k)
+                rounds = sum(int(r) for r, _ in traffic.values())
+                nbytes = sum(int(b) for _, b in traffic.values())
+                detail = {
+                    "ways": w, "turns": mesh_turns, "k": k,
+                    "board": [n, n], "elapsed_s": round(elapsed, 4),
+                    "mesh": mesh_geometry(mesh),
+                    "halo_traffic": {
+                        a: {"rounds": int(r), "bytes": int(b)}
+                        for a, (r, b) in traffic.items()},
+                    "alive_parity": parity,
+                    "parity_check": f"{mesh_turns}-turn full-board "
+                                    f"equality vs 1-way k=1 replay",
+                }
+                _emit(f"cell-updates/sec (fused, k={k}, {n}x{n} "
+                      f"{w}-way)",
+                      round(cups, 1), "cell-updates/s", None, detail)
+                _emit(f"halo exchanges/turn (fused, k={k}, {w}-way)",
+                      round(rounds / mesh_turns, 6), "exchanges/turn",
+                      None, detail)
+                _emit(f"halo bytes/turn (fused, k={k}, {w}-way)",
+                      round(nbytes / mesh_turns, 1), "bytes/turn",
+                      None, detail)
+    return rc
+
+
 def bench_generations(n: int, turns: int,
                       rulestring: str = "/2/3") -> int:
     """Opt-in leg (`--gen [--gen-rule R]`): a 3- or 4-state rule on its
@@ -1252,6 +1429,8 @@ def bench_fleet(run_counts=FLEET_RUN_COUNTS, n: int = 512,
             "turns_per_run_per_s": round(
                 turns_ret / count / elapsed, 1),
             "chunk_turns": eng.chunk_turns,
+            "fuse_k": eng.fuse_k,
+            "turns_per_dispatch": eng.turns_per_dispatch,
             "p50_turn_latency_ms": round(p50 * 1e3, 3),
             "p99_turn_latency_ms": round(p99 * 1e3, 3),
             "chunk_overhead_us": overhead,
@@ -1452,6 +1631,8 @@ def bench_fleet_mesh(ways=FLEET_MESH_WAYS,
                 "turns_per_run_per_s": round(
                     turns_ret / count / elapsed, 1),
                 "chunk_turns": eng.chunk_turns,
+                "fuse_k": eng.fuse_k,
+                "turns_per_dispatch": eng.turns_per_dispatch,
                 "p50_turn_latency_ms": round(p50 * 1e3, 3),
                 "p99_turn_latency_ms": round(p99 * 1e3, 3),
                 "new_step_signatures_in_window": int(new_sigs),
@@ -2076,6 +2257,17 @@ def main() -> int:
                     help="with --mesh: comma-separated mesh widths "
                          "(default 2,4,8; widths beyond the device "
                          "count are skipped with a note)")
+    ap.add_argument("--fuse", action="store_true",
+                    help="run the temporal-fusion k-sweep legs only: "
+                         "dense boards + 1-D mesh legs, every k "
+                         "parity-gated bit-identical vs the k=1 torus "
+                         "replay (combine with --size/--turns/"
+                         "--fuse-ks/--mesh-ways)")
+    ap.add_argument("--fuse-ks", default="", metavar="K[,K...]",
+                    help="with --fuse: comma-separated fusion depths "
+                         "(default 1,2,4,8,16; 1 is the parity/"
+                         "throughput control and is always a good "
+                         "idea to keep)")
     ap.add_argument("--ksweep", action="store_true",
                     help="two-point K-sweep for --size: marginal "
                          "per-turn cost + asymptotic cups + roofline")
@@ -2084,7 +2276,7 @@ def main() -> int:
                          "gol-run-report/1 bench_leg record to PATH "
                          "(same schema family as --run-report)")
     args = ap.parse_args()
-    if args.mesh:
+    if args.mesh or args.fuse:
         # Multi-device legs need devices. On hosts where XLA has not
         # been configured the CPU platform exposes ONE device; force 8
         # virtual host devices — but only when the user hasn't pinned a
@@ -2178,6 +2370,37 @@ def _dispatch(args, ap) -> int:
             ap.error("--federation is its own config; it takes no "
                      "other leg flags")
         return bench_federation()
+
+    if args.fuse:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.load or args.chaos or args.fleet \
+                or args.mesh:
+            ap.error("--fuse is its own config; combine only with "
+                     "--size/--turns/--fuse-ks/--mesh-ways")
+        ks = FUSE_KS
+        if args.fuse_ks:
+            try:
+                ks = tuple(int(x) for x in
+                           args.fuse_ks.split(",") if x.strip())
+            except ValueError:
+                ap.error("--fuse-ks wants comma-separated integers")
+            if not ks or min(ks) < 1:
+                ap.error("--fuse-ks wants fusion depths >= 1")
+        ways = FUSE_MESH_WAYS
+        if args.mesh_ways:
+            try:
+                ways = tuple(int(x) for x in
+                             args.mesh_ways.split(",") if x.strip())
+            except ValueError:
+                ap.error("--mesh-ways wants comma-separated integers")
+            if not ways or min(ways) < 2:
+                ap.error("--mesh-ways wants mesh widths >= 2")
+        sizes = (args.size,) if args.size is not None else None
+        return bench_fuse(ks=ks, sizes=sizes,
+                          turns_override=args.turns or 0, ways=ways)
+    if args.fuse_ks:
+        ap.error("--fuse-ks applies to the --fuse leg only")
 
     if args.mesh and args.fleet:
         # The mesh-sharded fleet matrix (PR 11): run-count x mesh-width

@@ -283,6 +283,9 @@ class CheckpointWriter:
             mesh_geom = _mesh_ident()
             if mesh_geom:
                 manifest["mesh"] = mesh_geom
+            fuse_k = _fuse_ident()
+            if fuse_k is not None:
+                manifest["fuse"] = fuse_k
             if snap.extra:
                 manifest["sparse"] = {k: int(v)
                                       for k, v in snap.extra.items()}
@@ -455,6 +458,20 @@ def _mesh_ident() -> Optional[dict]:
     except Exception:  # telemetry must never sink a checkpoint
         return None
     return mesh or None
+
+
+def _fuse_ident() -> Optional[int]:
+    """Temporal-fusion depth of the run being checkpointed (the engine
+    stamps it at submit via devstats.note_fuse); None when unfused so
+    legacy manifests stay byte-identical. read_manifest tolerates extra
+    keys, so old readers skip it."""
+    try:
+        from gol_tpu.obs import devstats
+
+        fuse_k = devstats.fuse_field()
+    except Exception:  # telemetry must never sink a checkpoint
+        return None
+    return fuse_k if fuse_k > 1 else None
 
 
 def _device_ident() -> Optional[dict]:

@@ -630,6 +630,17 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
         # one resolution shared by every family branch.
         requested = len(sub_workers) if sub_workers else params.threads
         requested = max(1, min(requested, len(self._devices)))
+        # Temporal fusion (GOL_FUSE_K): resolve the pinned depth ONCE at
+        # submit and select a stable-identity fused run fn, so the
+        # jit/_tokened_run caches key on the depth via run-fn identity —
+        # an env flip mid-process can never serve a stale compiled
+        # program. fuse_eff records the depth this run actually applies
+        # (1 where a branch has no fused tier: u8/gen8 boards, the
+        # wrap-extension path, multi-shard gen3).
+        from gol_tpu.ops.fused import configured_fuse_k
+
+        fuse = configured_fuse_k()
+        fuse_eff = 1
         if isinstance(self._rule, GenerationsRule):
             # Multi-state family on the SAME control stack (r4 — VERDICT
             # r3 weak #5): uint8 states row-sharded through the generic
@@ -678,6 +689,14 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                 else:
                     stacked = jnp.stack([a, d])
                     run = sharded_gen3_run_turns
+                    if fuse > 1 and requested == 1:
+                        # Multi-shard gen3 stays per-turn (a k-deep gen3
+                        # halo would ship both planes — a different
+                        # traffic model, see sharded_gen3_run_turns).
+                        from gol_tpu.parallel.halo import fused_gen3_run_fn
+
+                        run = fused_gen3_run_fn(fuse)
+                        fuse_eff = fuse
                 cells = shard_board_gen3(stacked, mesh)
             else:
                 repr_ = "gen8"
@@ -704,6 +723,11 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
 
                 mesh = mesh2d
                 run = sharded_packed_run_turns_2d
+                if fuse > 1:
+                    from gol_tpu.parallel.mesh2d import fused_run_fn_2d
+
+                    run = fused_run_fn_2d(fuse)
+                    fuse_eff = fuse
                 cells = shard_board2d(pack(cells01), mesh)
             else:
                 from gol_tpu.parallel.halo import (
@@ -731,6 +755,11 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                     mesh = make_mesh(requested, self._devices)
                     cells = shard_board(
                         pack(cells01) if packed else cells01, mesh)
+                    if packed and fuse > 1:
+                        from gol_tpu.parallel.halo import fused_run_fn
+
+                        run = fused_run_fn(fuse)
+                        fuse_eff = fuse
         with self._state_lock:
             if self._running:  # re-check under the lock (TOCTOU)
                 raise EngineBusy("engine already running a board")
@@ -760,12 +789,13 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
         # only a starting point — if the regime changed (env caps, a
         # slower link) the adapters re-correct within a few chunks.
         hint_key = (cells.shape, repr_, tuple(mesh.devices.shape),
-                    self._chunk_target)
-        # Recompile-churn signal: a new (repr, shape, dtype, mesh, rule)
-        # tuple means jit will trace + compile a fresh step executable.
+                    self._chunk_target, fuse_eff)
+        # Recompile-churn signal: a new (repr, shape, dtype, mesh, rule,
+        # fuse) tuple means jit will trace + compile a fresh step
+        # executable (the fuse depth is baked into the compiled macro).
         obs_devstats.note_signature(
             (repr_, tuple(cells.shape), str(cells.dtype),
-             tuple(mesh.devices.shape), self._rule.rulestring))
+             tuple(mesh.devices.shape), self._rule.rulestring, fuse_eff))
         # Floor to a power of two <= the cap: min() alone would hand a
         # non-power-of-two GOL_MAX_CHUNK straight to the dispatch loop,
         # breaking the bounded-compiled-program invariant (_next_chunk).
@@ -786,6 +816,9 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
         mesh_geom = mesh_geometry(mesh)
         self._mesh_geom = mesh_geom
         obs_devstats.note_mesh(mesh_geom)
+        # Fusion stamp: gol_fuse_k gauge now, checkpoint manifests later
+        # (the writer reads devstats.fuse_field(), same pattern as mesh).
+        obs_devstats.note_fuse(fuse_eff)
         if reporter is not None:
             reporter.emit(
                 "run_start", w=width, h=height,
@@ -793,7 +826,7 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                 devices=int(mesh.size), shards=mesh_geom["shards"],
                 mesh_shape=mesh_geom["shape"], mesh_axes=mesh_geom["axes"],
                 turns_requested=params.turns,
-                start_turn=start_turn)
+                start_turn=start_turn, fuse_k=fuse_eff)
         obs.ENGINE_CHUNK_SIZE.set(chunk)
         # GOL_PROFILE_DIR: one-shot env contract (set by --profile-dir)
         # — arm an on-demand profiler capture of this run's first
@@ -878,7 +911,8 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             from gol_tpu.parallel.halo import halo_traffic
 
             halo_traffic_fn = functools.partial(
-                halo_traffic, repr_, tuple(cells.shape), mesh)
+                halo_traffic, repr_, tuple(cells.shape), mesh,
+                fuse=fuse_eff)
         last_cups = 0.0
         last_rate = 0.0
         last_done_turn = start_turn
@@ -899,6 +933,9 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             """Drain the batched hot-loop telemetry into the registry."""
             nonlocal pend_chunks, pend_turns, last_flush
             if pend_chunks:
+                if fuse_eff > 1:
+                    obs.FUSED_DISPATCHES.labels(tier="engine").inc(
+                        pend_chunks)
                 obs.ENGINE_CHUNKS_TOTAL.inc(pend_chunks)
                 obs.ENGINE_TURNS_TOTAL.inc(pend_turns)
                 obs.ENGINE_CHUNK_SECONDS.observe_batch(pend_elapsed)

@@ -344,20 +344,23 @@ class Bucket:
 
     # --------------------------------------------------------- dispatch
 
-    def signature_key(self, turns: int) -> tuple:
+    def signature_key(self, turns: int, fuse: int = 1) -> tuple:
         # Placement and device count are part of the compiled-program
         # identity: jit caches per input sharding, so a 4-way batch
         # program is a different executable than the 1-device one and
         # must count as a different signature for the witness to stay
-        # honest.
+        # honest. The fuse depth rides the key the same way — a fused
+        # fleet runs a (turns × fuse)-deep scan, a different executable
+        # — while admit-into-capacity still compiles nothing: slot
+        # admission changes neither the batch shape nor (turns, fuse).
         return ("fleet", self.cap, self.hb, self.wpb, turns,
-                self.rule.rulestring, self.placement, self.devices)
+                self.rule.rulestring, self.placement, self.devices, fuse)
 
-    def dispatch(self, turns: int):
-        """One serving quantum: advance every slot `turns` turns in a
-        single device dispatch. Returns the per-slot popcount DEVICE
-        array — the caller decides when to sync (that sync is the
-        fleet's device-wait measurement point).
+    def dispatch(self, turns: int, fuse: int = 1):
+        """One serving quantum: advance every slot `turns × fuse` turns
+        in a single device dispatch. Returns the per-slot popcount
+        DEVICE array — the caller decides when to sync (that sync is
+        the fleet's device-wait measurement point).
 
         Batch placement needs no bespoke program: `self.words` carries
         the slots-axis NamedSharding, and jit (pjit) propagates it
@@ -365,13 +368,21 @@ class Bucket:
         each device's slot block and the popcount reduction is over
         unsharded trailing axes, so the compiled SPMD program moves zero
         bytes between devices. Spatial placement dispatches the
-        shard_map halo program instead."""
-        devstats.note_signature(self.signature_key(turns))
+        shard_map halo program instead.
+
+        Temporal fusion at this tier is dispatch-granularity: the
+        bucket scan already keeps the batch device-resident for the
+        whole quantum, so fuse-k multiplies the turns one program
+        advances per dispatch — k× fewer popcount round trips and
+        program launches per turn, per-slot popcounts still riding the
+        (now deeper) dispatch."""
+        total = turns * max(1, fuse)
+        devstats.note_signature(self.signature_key(turns, max(1, fuse)))
         if self.placement == "spatial":
-            prog = spatial_step_program(self.rule, turns, self.mesh)
+            prog = spatial_step_program(self.rule, total, self.mesh)
         else:
-            prog = step_program(self.rule, turns)
+            prog = step_program(self.rule, total)
         self.words, alive = prog(self.words)
         self.dispatches += 1
-        self.turns_served += turns
+        self.turns_served += total
         return alive

@@ -173,6 +173,16 @@ class FleetEngine(ControlFlagProtocol):
         self.bucket_sizes = tuple(sorted(sizes))
         self.chunk_turns = int(chunk_turns) if chunk_turns else env_int(
             CHUNK_ENV, DEFAULT_CHUNK, minimum=1)
+        # Temporal fusion (GOL_FUSE_K): every serving quantum advances
+        # chunk_turns × fuse_k turns in ONE bucket dispatch — k× fewer
+        # popcount syncs and program launches per turn. Resolved once at
+        # construction so the compiled-program set stays fixed for the
+        # engine's lifetime (auto/0 means unfused here: the bucket scan
+        # has no adaptive depth to keep).
+        from gol_tpu.ops.fused import configured_fuse_k
+
+        self.fuse_k = max(1, configured_fuse_k())
+        obs_devstats.note_fuse(self.fuse_k)
         self.slot_base = int(slot_base) if slot_base else env_int(
             SLOT_BASE_ENV, DEFAULT_SLOT_BASE, minimum=1)
         self.admission = admission or AdmissionController(
@@ -665,6 +675,14 @@ class FleetEngine(ControlFlagProtocol):
         h = self._legacy_or_raise()
         return self._view_of(h, max_cells)
 
+    @property
+    def turns_per_dispatch(self) -> int:
+        """Effective turns one serving quantum advances: the configured
+        chunk × the temporal-fusion depth. Every turn-accounting site
+        (handle advance, rollback, trim, counters, latency) uses THIS,
+        not chunk_turns — a fused fleet's turn ledger stays exact."""
+        return self.chunk_turns * self.fuse_k
+
     def stats(self) -> dict:
         self._check_alive()
         with self._fleet_lock:
@@ -689,6 +707,8 @@ class FleetEngine(ControlFlagProtocol):
                 "fleet": {
                     "buckets": bucket_rows,
                     "chunk_turns": self.chunk_turns,
+                    "fuse_k": self.fuse_k,
+                    "turns_per_dispatch": self.turns_per_dispatch,
                     "mesh": self._mesh_doc_locked(),
                     **self.runs_summary(),
                 },
@@ -1076,6 +1096,9 @@ class FleetEngine(ControlFlagProtocol):
             nonlocal pend_chunks, pend_turns, last_flush
             nonlocal overhead_accum, overhead_iters
             if pend_chunks:
+                if self.fuse_k > 1:
+                    obs.FUSED_DISPATCHES.labels(tier="fleet").inc(
+                        pend_chunks)
                 obs.ENGINE_CHUNKS_TOTAL.inc(pend_chunks)
                 obs.ENGINE_TURNS_TOTAL.inc(pend_turns)
                 obs.ENGINE_CHUNK_SECONDS.observe_batch(pend_elapsed)
@@ -1093,7 +1116,7 @@ class FleetEngine(ControlFlagProtocol):
                 obs.ENGINE_TURNS_PER_S.set(last_rate)
             with self._state_lock:
                 obs.ENGINE_TURN.set(self._turn)
-            obs.ENGINE_CHUNK_SIZE.set(self.chunk_turns)
+            obs.ENGINE_CHUNK_SIZE.set(self.turns_per_dispatch)
             obs.RUNS_RESIDENT.set(self.runs_summary()["resident"])
             obs.FLEET_MESH_DEVICES.set(len(self._devices))
             for dev, n in enumerate(self._device_resident_locked()):
@@ -1114,9 +1137,10 @@ class FleetEngine(ControlFlagProtocol):
                     self._wake.wait(timeout=0.2)
                     continue
                 key, bucket = picked
-                chunk = self.chunk_turns
+                chunk = self.turns_per_dispatch
                 try:
-                    alive_dev = bucket.dispatch(chunk)
+                    alive_dev = bucket.dispatch(self.chunk_turns,
+                                                fuse=self.fuse_k)
                 except Exception as e:
                     self._dispatch_failed_locked(bucket, e)
                     continue
@@ -1375,7 +1399,7 @@ class FleetEngine(ControlFlagProtocol):
                     rem = h.target_turn - h.turn
                     if rem <= 0:
                         self._park_locked(self._buckets[h.bucket_key], h)
-                    elif rem < self.chunk_turns:
+                    elif rem < self.turns_per_dispatch:
                         self._trim_locked(h, rem)
         self._wake.notify_all()
 
@@ -1502,7 +1526,7 @@ class FleetEngine(ControlFlagProtocol):
             victims = stepped
             for _slot, h in victims:
                 if h.state == "resident":
-                    h.turn -= self.chunk_turns
+                    h.turn -= self.turns_per_dispatch
         for _slot, h in victims:
             if h.state == "resident":
                 self._quarantine_locked(bucket, h, "step")

@@ -611,15 +611,55 @@ SHARD_IMBALANCE = REGISTRY.gauge(
     "measure_shard_imbalance): a completion-spread signal (1.0 = "
     "balanced), not a per-device timer.")
 
+FUSE_K = REGISTRY.gauge(
+    "gol_fuse_k",
+    "Effective temporal-fusion depth of the most recently submitted "
+    "run: generations advanced per materialized HBM state / per halo "
+    "exchange round (ops/fused.py). 1 = auto/unfused (each dispatch "
+    "tier keeps its native adaptive depth).")
+# Closed dispatch-site label set: the three stack tiers that route
+# through a fused program when GOL_FUSE_K pins a depth.
+FUSE_TIERS = ("engine", "mesh", "fleet")
+FUSED_DISPATCHES = REGISTRY.counter(
+    "gol_fused_dispatches_total",
+    "Temporally fused dispatches issued (k > 1 only), by dispatch "
+    "site: 'engine' single/sharded chunk dispatches, 'mesh' bench mesh "
+    "legs, 'fleet' bucket scans.",
+    label_names=("tier",))
+HALO_BYTES_PER_TURN = REGISTRY.gauge(
+    "gol_halo_bytes_per_turn",
+    "Analytic halo bytes per TURN of the most recent sharded dispatch, "
+    "by mesh axis. Conserved under temporal fusion on the rows axis (a "
+    "k-deep exchange ships 2k rows per k turns = 2 rows/turn) — the "
+    "honest companion to the k-fold drop in exchange rounds/turn.",
+    label_names=("axis",))
+HALO_EXCHANGES_PER_TURN = REGISTRY.gauge(
+    "gol_halo_exchanges_per_turn",
+    "Analytic halo exchange rounds per TURN of the most recent sharded "
+    "dispatch, by mesh axis: 1/T for a T-deep macro schedule — drops "
+    "~k-fold when GOL_FUSE_K pins the depth (the latency-exposure win "
+    "of temporal fusion).",
+    label_names=("axis",))
+
 for _a in MESH_AXES:
     MESH_AXIS_SIZE.labels(axis=_a)
     HALO_EXCHANGES.labels(axis=_a)
     HALO_BYTES.labels(axis=_a)
+    HALO_BYTES_PER_TURN.labels(axis=_a)
+    HALO_EXCHANGES_PER_TURN.labels(axis=_a)
+for _t in FUSE_TIERS:
+    FUSED_DISPATCHES.labels(tier=_t)
+FUSE_K.set(1)
 
 
 def mesh_axis_label(axis: str) -> str:
     """Clamp arbitrary mesh-axis names to the declared set."""
     return axis if axis in MESH_AXES else "other"
+
+
+def fuse_tier_label(tier: str) -> str:
+    """Clamp arbitrary fused-dispatch site names to the declared set."""
+    return tier if tier in FUSE_TIERS else "engine"
 
 
 # Per-device kind census: heterogeneous device lists (a CPU host plus

@@ -49,6 +49,7 @@ _last_poll: dict = {
 # note_mesh at submit; bench legs may too). Same cached-for-healthz
 # contract as _last_poll: the HTTP layer reads this dict, never jax.
 _mesh: dict = {}
+_fuse_k: int = 1
 
 # memory_stats() key aliases across backends.  TPU/GPU PJRT clients use
 # bytes_in_use/peak_bytes_in_use; bytes_limit is best-effort.
@@ -216,6 +217,25 @@ def mesh_fields() -> dict:
     note_mesh) — never imports jax."""
     with _lock:
         return dict(_mesh)
+
+
+def note_fuse(fuse_k: int) -> None:
+    """Record the most recently submitted run's effective temporal-
+    fusion depth (1 = auto/unfused) and publish the gol_fuse_k gauge.
+    Called by the engines at run submit, read back by the checkpoint
+    writer so manifests attribute state to a kernel config."""
+    global _fuse_k
+    fuse_k = max(1, int(fuse_k))
+    with _lock:
+        _fuse_k = fuse_k
+    _cat.FUSE_K.set(float(fuse_k))
+
+
+def fuse_field() -> int:
+    """Cached fuse depth of the last submitted run (1 before any
+    note_fuse) — never imports jax."""
+    with _lock:
+        return _fuse_k
 
 
 def healthz_fields() -> dict:

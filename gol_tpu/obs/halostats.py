@@ -45,14 +45,31 @@ from gol_tpu.obs import catalog as _cat
 Traffic = Dict[str, Tuple[int, int]]
 
 
-def note_traffic(traffic: Traffic) -> None:
-    """Fold one dispatch's analytic traffic into the counters."""
+def note_traffic(traffic: Traffic, num_turns: int = 0) -> None:
+    """Fold one dispatch's analytic traffic into the counters. When the
+    caller supplies the dispatch's turn count, also refresh the per-turn
+    gauges (`gol_halo_bytes_per_turn` / `gol_halo_exchanges_per_turn`) —
+    the temporal-fusion observables: bytes/turn is CONSERVED under
+    fusion while exchanges/turn drops ~k-fold (see catalog help)."""
     for axis, (rounds, nbytes) in traffic.items():
         lab = _cat.mesh_axis_label(axis)
         if rounds:
             _cat.HALO_EXCHANGES.labels(axis=lab).inc(int(rounds))
         if nbytes:
             _cat.HALO_BYTES.labels(axis=lab).inc(int(nbytes))
+    if num_turns > 0:
+        _set_per_turn(traffic, num_turns)
+
+
+def _set_per_turn(per_axis: Traffic, num_turns: int) -> None:
+    """Publish the per-turn halo gauges from an {axis: (rounds, bytes)}
+    aggregate spanning `num_turns` turns."""
+    for axis, (rounds, nbytes) in per_axis.items():
+        lab = _cat.mesh_axis_label(axis)
+        _cat.HALO_EXCHANGES_PER_TURN.labels(axis=lab).set(
+            rounds / num_turns)
+        _cat.HALO_BYTES_PER_TURN.labels(axis=lab).set(
+            nbytes / num_turns)
 
 
 def total_rounds(traffic: Traffic) -> int:
@@ -90,10 +107,12 @@ def flush_chunk_walls(
     the whole call is a handful of dict ops per flush window."""
     per_axis: Dict[str, Tuple[int, int]] = {}
     samples = []
+    turns = 0
     for k, wall in walls:
         traffic = traffic_for_k(k)
         if not traffic:
             continue
+        turns += int(k)
         rounds = 0
         for axis, (r, b) in traffic.items():
             er, eb = per_axis.get(axis, (0, 0))
@@ -107,6 +126,8 @@ def flush_chunk_walls(
             _cat.HALO_EXCHANGES.labels(axis=lab).inc(rounds)
         if nbytes:
             _cat.HALO_BYTES.labels(axis=lab).inc(nbytes)
+    if turns:
+        _set_per_turn(per_axis, turns)
     if samples:
         _cat.HALO_EXCHANGE_SECONDS.observe_batch(samples)
 

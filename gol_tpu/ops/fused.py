@@ -1,0 +1,284 @@
+"""Temporally fused macro-steps: k generations per materialized HBM state.
+
+The packed kernel is memory-bound (ROADMAP: 85.9% of measured roofline
+at 131072²), so the remaining lever is arithmetic intensity: advance k
+turns per HBM round trip instead of one. This module is the portable
+tier of that lever — the board is tiled into row blocks, each block is
+widened by a k-row halo margin on both sides, the (block + 2k)-row
+window is stepped k times as its own vertical torus, and the exact
+middle `block` rows are written back:
+
+        global rows      [start - k .............. start + B + k)
+        window           |<-- k -->|<---- B ---->|<-- k -->|
+        after t steps     t dirty rows eat inward from each edge
+        after k steps     the middle B rows are exact; trim the margins
+
+Correctness is the deep-halo corruption-front argument
+(`parallel/halo.py`): the window's vertical wrap feeds wrong rows to
+its edges, but the corruption advances exactly one row per turn, so
+after k turns it has consumed precisely the 2k margin rows and the
+block itself is bit-identical to k applications of the radius-1 torus
+step. The full board width is kept in every window, so the horizontal
+torus wrap needs no margin at all. Blocks are processed sequentially
+(`lax.map`), so the k inner updates of one window run against a
+working set of (block + 2k)·Wp words — sized to stay cache/VMEM
+resident — instead of re-streaming the whole board per turn.
+
+On TPU the same schedule runs as the banded pallas kernel at depth k
+(`ops/pallas_stencil.fused_banded_run_turns`) whenever Mosaic's
+8-sublane alignment admits it (k % 8 == 0); elsewhere this jnp tier is
+the one program, bit-identical by construction for every life-like rule
+and for the packed Generations plane pairs.
+
+Depth selection: `GOL_FUSE_K` pins k explicitly (0/unset = auto, which
+keeps each dispatch tier's native adaptive depth — the banded kernel's
+BAND_T, the mesh deep-halo T — i.e. legacy behavior). A turn count not
+divisible by k finishes with single radius-1 steps (the trim scan), so
+chunk clamps and checkpoint-turn exactness hold for any k.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+from gol_tpu.ops.bitpack import packed_run_turns, packed_step
+from gol_tpu.utils.envcfg import env_int
+
+FUSE_K_ENV = "GOL_FUSE_K"
+# A fuse depth past this is all margin: redundant-compute overhead
+# (2k / block) grows while the HBM saving (1/k of the passes) has long
+# flattened — and the engine's chunk sizes rarely exceed it anyway.
+MAX_FUSE_K = 64
+
+# Per-window byte budget for the jnp tier: block + 2k rows of packed
+# words must fit a cache-resident working set. Overridable for tuning
+# (read when a fused program is first traced for a shape).
+FUSE_BLOCK_BYTES_ENV = "GOL_FUSE_BLOCK_BYTES"
+DEFAULT_FUSE_BLOCK_BYTES = 8 * 1024 * 1024
+
+
+def configured_fuse_k() -> int:
+    """The pinned fuse depth from GOL_FUSE_K, clamped to [0, MAX_FUSE_K].
+    0 (or unset/garbage) means auto: dispatch tiers keep their native
+    adaptive depths and no explicit macro-stepping is forced."""
+    return min(env_int(FUSE_K_ENV, 0, minimum=0), MAX_FUSE_K)
+
+
+def fuse_block_rows(height: int, wp: int, fuse: int,
+                    budget: Optional[int] = None) -> int:
+    """Largest divisor B of `height` whose (B + 2·fuse)-row window fits
+    the byte budget, subject to B >= 2·fuse (margin recompute bounded at
+    2x useful work). Returns 0 when no divisor qualifies and `height`
+    when only the whole board does — both cases mean the caller should
+    run the plain scan (no tiling is possible or profitable)."""
+    if budget is None:
+        budget = env_int(FUSE_BLOCK_BYTES_ENV, DEFAULT_FUSE_BLOCK_BYTES)
+    row_bytes = wp * 4
+    best = 0
+    for cand in range(max(1, 2 * fuse), height + 1):
+        if height % cand:
+            continue
+        if (cand + 2 * fuse) * row_bytes > budget:
+            break  # windows only grow with the block size
+        best = cand
+    return best
+
+
+def _window_index(height: int, block: int, fuse: int) -> np.ndarray:
+    """(n_blocks, block + 2·fuse) modular row indices: row j of window i
+    is global row (i·block + j - fuse) mod height."""
+    nb = height // block
+    return ((np.arange(-fuse, block + fuse)[None, :]
+             + np.arange(nb)[:, None] * block) % height).astype(np.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_turns", "fuse", "block", "rule")
+)
+def _fused_packed_scan(
+    packed: jax.Array, num_turns: int, fuse: int, block: int,
+    rule: LifeLikeRule,
+) -> jax.Array:
+    """The jnp windowed fused program for a (H, Wp) packed board:
+    full k-deep macro-steps, then the single-step trim scan for the
+    `num_turns % fuse` remainder."""
+    height = packed.shape[-2]
+    idx = jnp.asarray(_window_index(height, block, fuse))
+    macros, rem = divmod(num_turns, fuse)
+
+    def macro(w, _):
+        def one(rows):
+            win = jnp.take(w, rows, axis=-2)
+
+            def body(x, _):
+                return packed_step(x, rule), None
+
+            out, _ = lax.scan(body, win, None, length=fuse)
+            return lax.slice_in_dim(out, fuse, fuse + block, axis=-2)
+
+        return lax.map(one, idx).reshape(w.shape), None
+
+    out, _ = lax.scan(macro, packed, None, length=macros)
+    if rem:
+        def single(x, _):
+            return packed_step(x, rule), None
+
+        out, _ = lax.scan(single, out, None, length=rem)
+    return out
+
+
+def _platform_of(arr, platform: Optional[str]) -> str:
+    if platform is not None:
+        return platform
+    devices = getattr(arr, "devices", None)
+    dev = next(iter(devices())) if devices else jax.devices()[0]
+    return dev.platform
+
+
+def fused_packed_run_turns(
+    packed: jax.Array,
+    num_turns: int,
+    rule: LifeLikeRule = CONWAY,
+    fuse: int = 0,
+    platform: Optional[str] = None,
+) -> jax.Array:
+    """Advance a (H, Wp) packed board `num_turns` turns at fuse depth
+    `fuse` — bit-identical to `packed_run_turns` for every life-like
+    rule by the margin-trim construction. `fuse <= 1` IS the plain scan
+    (the k=1 replay control the benches gate against). `platform` must
+    be supplied when `packed` may be a tracer (callers composing this
+    inside their own jit), same convention as the halo dispatchers."""
+    if num_turns <= 0:
+        return packed
+    if fuse <= 1:
+        return packed_run_turns(packed, num_turns, rule)
+    fuse = min(fuse, MAX_FUSE_K)
+    height, wp = packed.shape[-2], packed.shape[-1]
+    platform = _platform_of(packed, platform)
+    if platform == "tpu" and wp >= 2:
+        from gol_tpu.ops.pallas_stencil import fused_banded_supported
+
+        if fused_banded_supported(packed.shape, fuse):
+            from gol_tpu.ops.pallas_stencil import fused_banded_run_turns
+
+            return fused_banded_run_turns(packed, num_turns, fuse, rule)
+    block = fuse_block_rows(height, wp, fuse)
+    if block == 0 or block >= height:
+        # No divisor tiles profitably (tiny board, prime height, or the
+        # whole board already fits the window budget): the plain scan is
+        # the same bits without pointless margin recompute.
+        return packed_run_turns(packed, num_turns, rule)
+    return _fused_packed_scan(packed, num_turns, fuse, block, rule)
+
+
+# ------------------------------------------------- Generations planes
+#
+# The packed multi-state families ride the identical window schedule:
+# the stacked planes (2, H, Wp) are gathered per block along the row
+# axis (both planes — the window steps need the dying/encoding plane's
+# margins too, unlike the depth-1 halo exchange which only ships the
+# alive plane), stepped k times with the family's plane transition, and
+# trimmed. The corruption-front argument is family-independent: every
+# transition is radius-1 in the alive plane and radius-0 in the rest.
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_turns", "fuse", "block", "rule", "family"),
+)
+def _fused_planes_scan(
+    stacked: jax.Array, num_turns: int, fuse: int, block: int, rule,
+    family: str,
+) -> jax.Array:
+    from gol_tpu.models.generations import _packed_step3, _packed_step4
+
+    step2p = _packed_step3 if family == "gen3" else _packed_step4
+    height = stacked.shape[-2]
+    idx = jnp.asarray(_window_index(height, block, fuse))
+    macros, rem = divmod(num_turns, fuse)
+
+    def plane_step(s):
+        p0, p1 = step2p(s[0], s[1], rule)
+        return jnp.stack([p0, p1])
+
+    def macro(s, _):
+        def one(rows):
+            win = jnp.take(s, rows, axis=-2)
+
+            def body(x, _):
+                return plane_step(x), None
+
+            out, _ = lax.scan(body, win, None, length=fuse)
+            return lax.slice_in_dim(out, fuse, fuse + block, axis=-2)
+
+        centers = lax.map(one, idx)  # (nb, 2, block, Wp)
+        return jnp.moveaxis(centers, 0, 1).reshape(s.shape), None
+
+    out, _ = lax.scan(macro, stacked, None, length=macros)
+    if rem:
+        def single(x, _):
+            return plane_step(x), None
+
+        out, _ = lax.scan(single, out, None, length=rem)
+    return out
+
+
+def _fused_planes_run(stacked, num_turns, rule, fuse, platform, family,
+                      dispatch_fn):
+    """Shared gen3/gen4 routing: `dispatch_fn` is the family's native
+    engine dispatcher (which already runs the k-deep VMEM kernel on TPU
+    where the planes fit — the windowed tier must never shadow it)."""
+    if num_turns <= 0:
+        return stacked
+    platform = _platform_of(stacked, platform)
+    if fuse <= 1 or platform == "tpu":
+        return dispatch_fn(stacked, num_turns, rule, platform)
+    fuse = min(fuse, MAX_FUSE_K)
+    height, wp = stacked.shape[-2], stacked.shape[-1]
+    # Half the packed budget: BOTH planes ride each window.
+    block = fuse_block_rows(height, wp, fuse,
+                            budget=env_int(FUSE_BLOCK_BYTES_ENV,
+                                           DEFAULT_FUSE_BLOCK_BYTES) // 2)
+    if block == 0 or block >= height:
+        return dispatch_fn(stacked, num_turns, rule, platform)
+    return _fused_planes_scan(stacked, num_turns, fuse, block, rule,
+                              family)
+
+
+def fused_gen3_run_turns(
+    stacked: jax.Array, num_turns: int, rule, fuse: int = 0,
+    platform: Optional[str] = None,
+) -> jax.Array:
+    """Advance stacked packed (alive, dying) 3-state planes `num_turns`
+    turns at fuse depth `fuse`; `fuse <= 1` is the native dispatcher.
+    Returns the stacked planes (the engine's single-array convention)."""
+    from gol_tpu.models.generations import packed_run_turns3
+
+    def dispatch_fn(s, n, r, plat):
+        a, d = packed_run_turns3(s[0], s[1], n, r, platform=plat)
+        return jnp.stack([a, d])
+
+    return _fused_planes_run(stacked, num_turns, rule, fuse, platform,
+                             "gen3", dispatch_fn)
+
+
+def fused_gen4_run_turns(
+    stacked: jax.Array, num_turns: int, rule, fuse: int = 0,
+    platform: Optional[str] = None,
+) -> jax.Array:
+    """The C=4 sibling: stacked binary-encoded (b0, b1) planes."""
+    from gol_tpu.models.generations import packed_run_turns4
+
+    def dispatch_fn(s, n, r, plat):
+        o0, o1 = packed_run_turns4(s[0], s[1], n, r, platform=plat)
+        return jnp.stack([o0, o1])
+
+    return _fused_planes_run(stacked, num_turns, rule, fuse, platform,
+                             "gen4", dispatch_fn)

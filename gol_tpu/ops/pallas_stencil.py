@@ -323,20 +323,23 @@ def pallas_packed_run_turns4(
 BAND_T = 32  # turns per banded pass == halo depth (r3 sweep: beats 8/16)
 
 
-def _band_rows(height: int, wp: int) -> int:
-    """Largest 8-aligned divisor of `height` whose (B + 2*BAND_T, wp)
+def _band_rows(height: int, wp: int, halo_t: int = BAND_T) -> int:
+    """Largest 8-aligned divisor of `height` whose (B + 2*halo_t, wp)
     window fits the banded window budget; 0 if none exists or if the word
     axis is not 128-lane aligned (a Mosaic DMA slice requirement).
+    `halo_t` is the sweep depth — BAND_T for the native passes, the fuse
+    depth k for the temporally fused tier — and must itself be a multiple
+    of 8 (the same DMA alignment constraint as the band).
 
-    Bands must be at least BAND_T rows: a shorter band would let a halo
+    Bands must be at least `halo_t` rows: a shorter band would let a halo
     piece wrap around the torus INSIDE one DMA (the kernel's three-piece
     copy assumes wraps only happen at piece boundaries) and read out of
     bounds."""
-    if wp % 128 != 0:
+    if wp % 128 != 0 or halo_t <= 0 or halo_t % 8 != 0:
         return 0
-    max_b = BANDED_WINDOW_BYTES // (wp * 4) - 2 * BAND_T
+    max_b = BANDED_WINDOW_BYTES // (wp * 4) - 2 * halo_t
     b = 0
-    for cand in range(BAND_T, max_b + 1, 8):
+    for cand in range(halo_t, max_b + 1, 8):
         if height % cand == 0:
             b = cand
     return b
@@ -390,7 +393,7 @@ def _banded_pass(
 ) -> jax.Array:
     """Advance a big packed board `halo_t` turns in one banded sweep."""
     height, wp = packed.shape
-    band = _band_rows(height, wp)
+    band = _band_rows(height, wp, halo_t)
     if band == 0:
         raise ValueError(
             f"no viable band size for board {packed.shape}")
@@ -450,6 +453,49 @@ def banded_packed_run_turns(
             # Small turn counts on VMEM-fitting boards (e.g. the engine's
             # 1/2/4-turn starting chunks) use the whole-board VMEM kernel
             # rather than regressing to the HBM-bound jnp scan.
+            p = pallas_packed_run_turns(p, rem, rule, interpret)
+        else:
+            p = packed_run_turns(p, rem, rule)
+    return p
+
+
+def fused_banded_supported(shape, fuse: int) -> bool:
+    """Whether the banded kernel can sweep `fuse` turns per HBM pass on
+    this board: the fuse depth must satisfy Mosaic's 8-sublane DMA
+    alignment and some 8-aligned divisor band must fit the window budget
+    with 2·fuse margin rows."""
+    return (fuse > 0 and fuse % 8 == 0
+            and _band_rows(shape[-2], shape[-1], fuse) > 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_turns", "fuse", "rule", "interpret")
+)
+def fused_banded_run_turns(
+    packed: jax.Array,
+    num_turns: int,
+    fuse: int,
+    rule: LifeLikeRule = CONWAY,
+    interpret: bool = False,
+) -> jax.Array:
+    """Advance a packed board `num_turns` turns by `fuse`-deep banded
+    sweeps — the TPU tier of the temporal-fusion lever (`ops/fused.py`):
+    each sweep reads the board (plus 2·fuse/band margin) from HBM once
+    and advances `fuse` turns in VMEM. The `num_turns % fuse` remainder
+    reuses the native banded remainder policy (shallower 8-aligned
+    sweep, else the VMEM kernel, else the jnp trim scan)."""
+    from gol_tpu.ops.bitpack import packed_run_turns
+
+    full, rem = divmod(num_turns, fuse)
+    p = packed
+    if full:
+        def body(c, _):
+            return _banded_pass(c, fuse, rule, interpret), None
+        p, _ = lax.scan(body, p, None, length=full)
+    if rem:
+        if rem % 8 == 0 and _band_rows(p.shape[-2], p.shape[-1], rem):
+            p = _banded_pass(p, rem, rule, interpret)
+        elif fits_in_vmem(p.shape):
             p = pallas_packed_run_turns(p, rem, rule, interpret)
         else:
             p = packed_run_turns(p, rem, rule)

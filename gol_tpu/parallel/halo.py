@@ -174,21 +174,38 @@ def batched_packed_local_step(batch: jax.Array, n_shards: int,
 DEEP_HALO_T = 16
 
 
-def _deep_halo_T(num_turns: int, shard_rows: int) -> int:
-    """Largest power of two that divides num_turns, capped by DEEP_HALO_T
-    and by the shard height (a halo can only come from the adjacent
-    shard)."""
+def _deep_halo_T(num_turns: int, shard_rows: int,
+                 cap: int = DEEP_HALO_T) -> int:
+    """Largest power of two that divides num_turns, capped by `cap`
+    (DEEP_HALO_T natively, the pinned fuse depth on the fused fallback
+    path) and by the shard height (a halo can only come from the
+    adjacent shard)."""
     t = 1
     while (
-        t * 2 <= min(DEEP_HALO_T, shard_rows)
+        t * 2 <= min(cap, shard_rows)
         and num_turns % (t * 2) == 0
     ):
         t *= 2
     return t
 
 
+def fused_halo_T(fuse: int, num_turns: int, shard_rows: int) -> int:
+    """The deep-halo depth a fuse-k dispatch actually uses: k itself
+    when it divides the dispatch and fits the shard height (one
+    exchange per k turns — the temporal-fusion contract), else the
+    largest power-of-two fallback capped by k (keeping the compiled
+    macro scan remainder-free). fuse <= 1 is the native adaptive
+    selection. `halo_traffic` mirrors this exactly — change both or
+    the analytic counters go dishonest."""
+    if fuse <= 1:
+        return _deep_halo_T(num_turns, shard_rows)
+    if fuse <= shard_rows and num_turns % fuse == 0:
+        return fuse
+    return _deep_halo_T(num_turns, shard_rows, cap=fuse)
+
+
 @functools.lru_cache(maxsize=1024)
-def halo_traffic(repr_, shape, mesh, num_turns) -> dict:
+def halo_traffic(repr_, shape, mesh, num_turns, fuse=0) -> dict:
     """Analytic ppermute traffic of ONE dispatch of `num_turns` turns:
     {axis: (exchange_rounds, total_bytes)}. An exchange round is one
     paired send (`exchange_halos` issues its two ppermutes together, so
@@ -205,18 +222,23 @@ def halo_traffic(repr_, shape, mesh, num_turns) -> dict:
 
     `repr_` is 'packed' | 'u8' | 'gen8' | 'gen3'; 2-D meshes (a 'cols'
     axis present) are packed-only and routed by the mesh itself.
-    `shape` must be a plain tuple (this is an lru_cache key)."""
+    `shape` must be a plain tuple (this is an lru_cache key). `fuse`
+    is the pinned fuse depth of the dispatch (0 = auto), mirrored
+    through the same depth selection as the run paths. Note the fused
+    byte totals are CONSERVED — a k-deep exchange ships 2k rows per k
+    turns, the same 2 rows/turn as per-turn exchange — while the
+    exchange-round (latency-exposure) count drops k-fold."""
     if num_turns <= 0:
         return {}
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_rows = int(axes.get(ROWS_AXIS, 1))
     if "cols" in axes:  # 2-D mesh (mesh2d.COLS_AXIS; literal avoids a
         n_cols = int(axes["cols"])  # circular import — mesh2d imports us)
-        from gol_tpu.parallel.mesh2d import MAX_T_2D
+        from gol_tpu.parallel.mesh2d import macro_T_2d
 
         h, wp = shape
         shard_rows, shard_cols = h // n_rows, wp // n_cols
-        T = min(MAX_T_2D, shard_rows)
+        T = macro_T_2d(shard_rows, fuse)
         full, rem = divmod(num_turns, T)
         depths = [T] * full + ([rem] if rem else [])
         out = {}
@@ -246,7 +268,7 @@ def halo_traffic(repr_, shape, mesh, num_turns) -> dict:
         row_bytes = shape[-1]
     if repr_ == "packed":
         shard_rows = rows_len // n_rows
-        T = _deep_halo_T(num_turns, shard_rows)
+        T = fused_halo_T(fuse, num_turns, shard_rows)
         if T > 1:
             rounds = num_turns // T
             return {ROWS_AXIS: (
@@ -255,7 +277,7 @@ def halo_traffic(repr_, shape, mesh, num_turns) -> dict:
     return {ROWS_AXIS: (rounds, rounds * 2 * row_bytes * n_rows)}
 
 
-def dispatch_obs(repr_, cells, num_turns, mesh):
+def dispatch_obs(repr_, cells, num_turns, mesh, fuse=0):
     """Host-side observability for one EAGER sharded dispatch: fold the
     analytic traffic into the gol_halo_* counters and, when span
     tracing is armed, return a 'halo.dispatch' span context covering
@@ -267,12 +289,17 @@ def dispatch_obs(repr_, cells, num_turns, mesh):
     if isinstance(cells, jax.core.Tracer):
         return contextlib.nullcontext()
     try:
-        traffic = halo_traffic(repr_, tuple(cells.shape), mesh, num_turns)
+        traffic = halo_traffic(repr_, tuple(cells.shape), mesh,
+                               num_turns, fuse)
         if not traffic:
             return contextlib.nullcontext()
         from gol_tpu.obs import halostats, trace
 
-        halostats.note_traffic(traffic)
+        halostats.note_traffic(traffic, num_turns)
+        if fuse > 1:
+            from gol_tpu.obs import catalog
+
+            catalog.FUSED_DISPATCHES.labels(tier="mesh").inc()
         if not trace.hot_spans_enabled():
             return contextlib.nullcontext()
         return trace.TRACER.span("halo.dispatch", attrs={
@@ -433,16 +460,27 @@ def sharded_packed_run_turns(
     num_turns: int,
     mesh: Mesh,
     rule: LifeLikeRule = CONWAY,
+    fuse: int = 0,
 ) -> jax.Array:
-    """Advance a row-sharded bit-packed board `num_turns` turns."""
+    """Advance a row-sharded bit-packed board `num_turns` turns. `fuse`
+    pins the temporal-fusion depth (0 = auto): a single shard routes to
+    the fused single-device tier (`ops/fused.py`), a multi-shard mesh
+    exchanges k-deep halos — one exchange round per k turns — via the
+    deep-halo macro path at T = k (`fused_halo_T`)."""
     n_shards = mesh.shape[ROWS_AXIS]
     if n_shards == 1:
         # Platform from the (static) mesh, not the array: jit-composable.
+        platform = mesh.devices.flat[0].platform
+        if fuse > 1:
+            from gol_tpu.ops.fused import fused_packed_run_turns
+
+            return fused_packed_run_turns(
+                packed, num_turns, rule, fuse, platform)
         return _single_device_packed_run(
-            packed, num_turns, rule, mesh.devices.flat[0].platform)
-    with dispatch_obs("packed", packed, num_turns, mesh):
+            packed, num_turns, rule, platform)
+    with dispatch_obs("packed", packed, num_turns, mesh, fuse):
         shard_rows = packed.shape[-2] // n_shards
-        T = _deep_halo_T(num_turns, shard_rows)
+        T = fused_halo_T(fuse, num_turns, shard_rows)
         if T > 1:
             window_shape = (shard_rows + 2 * T, packed.shape[-1])
             inner = inner_kind(mesh, window_shape, T)
@@ -450,6 +488,20 @@ def sharded_packed_run_turns(
             return run(packed, num_turns // T)
         return _make_compiled_run(mesh, rule, _packed_local_step)(
             packed, num_turns)
+
+
+@functools.lru_cache(maxsize=32)
+def fused_run_fn(fuse: int):
+    """A stable-identity (cells, k, mesh, rule) run callable pinning the
+    fuse depth — cached so the engine's `_tokened_run` lru cache keys on
+    one object per depth. (Reading GOL_FUSE_K at trace time instead
+    would freeze the first-seen value into the jit cache for the life
+    of the process.)"""
+    def run(cells, num_turns, mesh, rule=CONWAY):
+        return sharded_packed_run_turns(
+            cells, num_turns, mesh, rule, fuse=fuse)
+
+    return run
 
 
 # ----------------------------------------------- exact-N odd heights
@@ -705,17 +757,39 @@ def _gen3_single_run(rule, platform: str):
 
 
 def sharded_gen3_run_turns(
-    stacked: jax.Array, num_turns: int, mesh: Mesh, rule
+    stacked: jax.Array, num_turns: int, mesh: Mesh, rule, fuse: int = 0
 ) -> jax.Array:
     """Advance stacked packed (alive, dying) planes of a 3-state rule.
     Single-shard meshes dispatch straight to the best single-device
     gen3 engine (VMEM pallas kernel on TPU when the planes fit, else
-    the scan — same fast-path policy as the life-like board)."""
+    the scan — same fast-path policy as the life-like board); a pinned
+    fuse depth routes them through the fused windowed tier
+    (`ops/fused.fused_gen3_run_turns`). Multi-shard gen3 keeps the
+    per-turn alive-plane exchange: a k-deep gen3 halo would have to
+    ship BOTH planes' margins, changing the traffic model — out of the
+    fused tier's scope (documented in docs/ARCHITECTURE.md)."""
     if mesh.shape[ROWS_AXIS] == 1:
+        if fuse > 1:
+            from gol_tpu.ops.fused import fused_gen3_run_turns
+
+            return fused_gen3_run_turns(
+                stacked, num_turns, rule, fuse,
+                mesh.devices.flat[0].platform)
         return _gen3_single_run(
             rule, mesh.devices.flat[0].platform)(stacked, num_turns)
     with dispatch_obs("gen3", stacked, num_turns, mesh):
         return _make_compiled_gen3_run(mesh, rule)(stacked, num_turns)
+
+
+@functools.lru_cache(maxsize=32)
+def fused_gen3_run_fn(fuse: int):
+    """Stable-identity gen3 run callable pinning the fuse depth — the
+    gen3 sibling of `fused_run_fn`, same jit-cache-staleness rationale."""
+    def run(cells, num_turns, mesh, rule):
+        return sharded_gen3_run_turns(cells, num_turns, mesh, rule,
+                                      fuse=fuse)
+
+    return run
 
 
 def select_representation(width: int):

@@ -39,6 +39,17 @@ COLS_AXIS = "cols"
 MAX_T_2D = 16
 
 
+def macro_T_2d(shard_rows: int, fuse: int = 0) -> int:
+    """Macro depth for one 2-D dispatch: the native MAX_T_2D cap, or the
+    pinned fuse depth k when set — still hard-capped at 32 (the one-word
+    horizontal halo protects at most 32 turns of corruption) and at the
+    shard height. `parallel/halo.halo_traffic` mirrors this exactly for
+    the analytic counters — change both together."""
+    if fuse > 1:
+        return min(fuse, 2 * MAX_T_2D, shard_rows)
+    return min(MAX_T_2D, shard_rows)
+
+
 def make_mesh2d(
     shape: Tuple[int, int],
     devices: Optional[Sequence[jax.Device]] = None,
@@ -113,14 +124,17 @@ def sharded_packed_run_turns_2d(
     num_turns: int,
     mesh: Mesh,
     rule: LifeLikeRule = CONWAY,
+    fuse: int = 0,
 ) -> jax.Array:
     """Advance a 2-D-sharded packed board `num_turns` turns.
 
     Requirements: mesh axes ('rows', 'cols'); board divisible by the mesh.
     A single word column per shard is fine — the 32-bit halo word protects
     up to 32 turns of corruption regardless of shard width. Turn counts
-    are decomposed as full MAX_T_2D macros plus one shallower remainder
-    macro — any T ≥ 1 is valid here, so every count works."""
+    are decomposed as full `macro_T_2d` macros plus one shallower
+    remainder macro — any T ≥ 1 is valid here, so every count works.
+    `fuse` pins the macro depth (one exchange round per k turns,
+    capped at 32 by the one-word horizontal halo)."""
     n_rows = mesh.shape[ROWS_AXIS]
     n_cols = mesh.shape[COLS_AXIS]
     h, wp = packed.shape
@@ -129,13 +143,13 @@ def sharded_packed_run_turns_2d(
             f"board {packed.shape} not divisible by mesh "
             f"{n_rows}x{n_cols}")
     shard_rows, shard_cols = h // n_rows, wp // n_cols
-    T = min(MAX_T_2D, shard_rows)
+    T = macro_T_2d(shard_rows, fuse)
     inner = inner_kind(mesh, (shard_rows + 2 * T, shard_cols + 2), T)
     run = _make_compiled_run2d(mesh, rule, T, inner)
     full, rem = divmod(num_turns, T)
     # dispatch_obs routes 2-D traffic by the mesh's cols axis; the one
     # span covers both the full-depth macros and the remainder macro.
-    with dispatch_obs("packed", packed, num_turns, mesh):
+    with dispatch_obs("packed", packed, num_turns, mesh, fuse):
         out = run(packed, full)
         if rem:
             # The remainder window has a DIFFERENT height and depth —
@@ -146,3 +160,15 @@ def sharded_packed_run_turns_2d(
                 mesh, (shard_rows + 2 * rem, shard_cols + 2), rem)
             out = _make_compiled_run2d(mesh, rule, rem, inner_rem)(out, 1)
         return out
+
+
+@functools.lru_cache(maxsize=32)
+def fused_run_fn_2d(fuse: int):
+    """Stable-identity 2-D run callable pinning the fuse depth — the
+    2-D sibling of `parallel/halo.fused_run_fn` (same jit-cache
+    staleness rationale)."""
+    def run(cells, num_turns, mesh, rule=CONWAY):
+        return sharded_packed_run_turns_2d(
+            cells, num_turns, mesh, rule, fuse=fuse)
+
+    return run

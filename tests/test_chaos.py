@@ -437,11 +437,11 @@ def test_step_exception_quarantines_and_rebuilds(monkeypatch, tmp_path):
     real_dispatch = Bucket.dispatch
     calls = {"n": 0}
 
-    def flaky_dispatch(self, turns):
+    def flaky_dispatch(self, turns, fuse=1):
         calls["n"] += 1
         if calls["n"] == 4:  # after the turn-8 checkpoint exists
             raise RuntimeError("synthetic device fault")
-        return real_dispatch(self, turns)
+        return real_dispatch(self, turns, fuse)
 
     monkeypatch.setattr(Bucket, "dispatch", flaky_dispatch)
     q0 = obs_cat.RUNS_QUARANTINED.labels(reason="step").value

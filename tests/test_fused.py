@@ -1,0 +1,227 @@
+"""Temporal-fusion parity contracts: the fused macro-step tier
+(`ops/fused.py`) must be BIT-IDENTICAL to k radius-1 steps for every
+rule family it serves, across fuse depths, non-divisible board shapes
+(heights the block tiling doesn't divide, turn counts the fuse depth
+doesn't divide), and both fallback edges (whole-board budget, prime
+height). The window budget is pinned tiny via GOL_FUSE_BLOCK_BYTES so
+these tests genuinely exercise the windowed gather/trim path — with
+the default 8 MB budget every board this size falls back to the plain
+scan and the tiling code would never run.
+
+Also pins the fleet dispatch-granularity contract: `turns_per_dispatch
+== chunk_turns x fuse_k` at every accounting surface."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gol_tpu.models.generations import (
+    BRIANS_BRAIN,
+    STAR_WARS,
+    pack_state4,
+    run_turns as gen_run_turns,
+    unpack_state4,
+)
+from gol_tpu.models.lifelike import CONWAY, HIGHLIFE
+from gol_tpu.ops.bitpack import pack, packed_run_turns, unpack
+from gol_tpu.ops.fused import (
+    MAX_FUSE_K,
+    configured_fuse_k,
+    fuse_block_rows,
+    fused_gen3_run_turns,
+    fused_gen4_run_turns,
+    fused_packed_run_turns,
+)
+from gol_tpu.ops.reference import run_turns_np
+
+
+def _board01(h, w, seed=0, density=0.35):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
+# A budget small enough that every board in this file tiles into
+# several windows (row_bytes = w/32 * 4; see per-test block asserts).
+TINY = "256"
+
+
+# ------------------------------------------------ depth/block selection
+
+def test_configured_fuse_k_env(monkeypatch):
+    monkeypatch.delenv("GOL_FUSE_K", raising=False)
+    assert configured_fuse_k() == 0          # unset = auto
+    monkeypatch.setenv("GOL_FUSE_K", "8")
+    assert configured_fuse_k() == 8
+    monkeypatch.setenv("GOL_FUSE_K", "9999")
+    assert configured_fuse_k() == MAX_FUSE_K  # clamped
+    monkeypatch.setenv("GOL_FUSE_K", "garbage")
+    assert configured_fuse_k() == 0
+
+
+def test_fuse_block_rows_contract():
+    # block must divide height, satisfy B >= 2k, and fit the budget
+    # with its 2k-row margin.
+    b = fuse_block_rows(96, 1, 4, budget=256)
+    assert b and 96 % b == 0 and b >= 8 and (b + 8) * 4 <= 256
+    # prime height: only the whole board divides -> no tiling
+    assert fuse_block_rows(97, 1, 4, budget=256) == 0
+    # roomy budget: whole board fits -> caller runs the plain scan
+    assert fuse_block_rows(96, 1, 4, budget=1 << 30) == 96
+
+
+# ----------------------------------------------- life-like rule parity
+
+@pytest.mark.parametrize("fuse", [2, 3, 4, 8])
+@pytest.mark.parametrize("shape,turns", [((96, 64), 16), ((60, 32), 13)])
+def test_fused_conway_matches_reference(monkeypatch, fuse, shape,
+                                        turns):
+    """Fused output vs the pure-numpy oracle, windowed path forced.
+    13 % fuse != 0 on the (60, 32) leg exercises the single-step
+    remainder trim after the macro scan."""
+    monkeypatch.setenv("GOL_FUSE_BLOCK_BYTES", TINY)
+    h, w = shape
+    board = _board01(h, w, seed=h + fuse)
+    out = fused_packed_run_turns(pack(board), turns, CONWAY, fuse=fuse,
+                                 platform="cpu")
+    np.testing.assert_array_equal(
+        np.asarray(unpack(out))[:, :w], run_turns_np(board, turns))
+
+
+@pytest.mark.parametrize("fuse", [2, 4, 8])
+def test_fused_highlife_matches_plain_scan(monkeypatch, fuse):
+    monkeypatch.setenv("GOL_FUSE_BLOCK_BYTES", TINY)
+    packed = pack(_board01(96, 64, seed=fuse))
+    # the forced budget really tiles (several windows, not one)
+    assert 0 < fuse_block_rows(96, 2, fuse) < 96
+    out = fused_packed_run_turns(packed, 24, HIGHLIFE, fuse=fuse,
+                                 platform="cpu")
+    want = packed_run_turns(packed, 24, HIGHLIFE)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_fused_fallbacks_are_plain_scan_bits(monkeypatch):
+    # prime height (windowless) and default-budget (whole board fits):
+    # both edges must still be the exact plain-scan bits.
+    packed = pack(_board01(67, 32, seed=11))
+    want = np.asarray(packed_run_turns(packed, 10, CONWAY))
+    monkeypatch.setenv("GOL_FUSE_BLOCK_BYTES", TINY)
+    np.testing.assert_array_equal(
+        np.asarray(fused_packed_run_turns(packed, 10, CONWAY, fuse=4,
+                                          platform="cpu")), want)
+    monkeypatch.delenv("GOL_FUSE_BLOCK_BYTES", raising=False)
+    np.testing.assert_array_equal(
+        np.asarray(fused_packed_run_turns(packed, 10, CONWAY, fuse=4,
+                                          platform="cpu")), want)
+
+
+# --------------------------------------------- Generations family parity
+
+@pytest.mark.parametrize("fuse", [2, 4])
+@pytest.mark.parametrize("turns", [12, 7])
+def test_fused_gen3_matches_dense_oracle(monkeypatch, fuse, turns):
+    """Brian's Brain: fused stacked (alive, dying) planes vs the dense
+    jnp kernel, windowed path forced (gen planes get HALF the packed
+    budget — both planes ride each window)."""
+    monkeypatch.setenv("GOL_FUSE_BLOCK_BYTES", "512")
+    rng = np.random.default_rng(fuse * 100 + turns)
+    board = rng.integers(0, 3, size=(96, 64)).astype(np.uint8)
+    stacked = jnp.stack([pack((board == 1).astype(np.uint8)),
+                         pack((board == 2).astype(np.uint8))])
+    out = np.asarray(fused_gen3_run_turns(stacked, turns, BRIANS_BRAIN,
+                                          fuse=fuse, platform="cpu"))
+    want = np.asarray(gen_run_turns(jnp.asarray(board), turns,
+                                    BRIANS_BRAIN))
+    got = np.zeros_like(want)
+    got[np.asarray(unpack(out[0]))[:, :64] == 1] = 1
+    got[np.asarray(unpack(out[1]))[:, :64] == 1] = 2
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("fuse", [2, 4])
+def test_fused_gen4_matches_dense_oracle(monkeypatch, fuse):
+    """Star Wars (345/2/4): the binary-encoded two-plane path through
+    the same window schedule, including the 2->3->0 dying chain."""
+    monkeypatch.setenv("GOL_FUSE_BLOCK_BYTES", "512")
+    rng = np.random.default_rng(fuse)
+    board = rng.integers(0, 4, size=(96, 64)).astype(np.uint8)
+    b0, b1 = pack_state4(board)
+    out = np.asarray(fused_gen4_run_turns(jnp.stack([b0, b1]), 11,
+                                          STAR_WARS, fuse=fuse,
+                                          platform="cpu"))
+    want = np.asarray(gen_run_turns(jnp.asarray(board), 11, STAR_WARS))
+    got = unpack_state4(out[0], out[1])[:, :64]
+    np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------------- engine tier, pinned k
+
+def test_engine_pinned_fuse_parity_and_telemetry(monkeypatch):
+    """GOL_FUSE_K=4 through the FULL engine stack (chunk loop, halo
+    dispatch, checkpoint-turn exactness at a target the depth doesn't
+    divide) must land the same bits as the numpy oracle, stamp the
+    gol_fuse_k gauge, and meter fused engine dispatches."""
+    from gol_tpu.engine import Engine
+    from gol_tpu.obs import catalog as cat
+    from gol_tpu.params import Params
+
+    monkeypatch.setenv("GOL_FUSE_K", "4")
+    seed = _board01(64, 64, seed=33)
+    f0 = cat.FUSED_DISPATCHES.labels(tier="engine").value
+    eng = Engine()
+    p = Params(threads=8, image_width=64, image_height=64, turns=37)
+    got, turn = eng.server_distributor(p, seed * 255)
+    assert turn == 37
+    np.testing.assert_array_equal((got != 0).astype(np.uint8),
+                                  run_turns_np(seed, 37))
+    assert cat.FUSE_K.value == 4
+    assert cat.FUSED_DISPATCHES.labels(tier="engine").value > f0
+
+
+# ------------------------------------------- fleet dispatch granularity
+
+def test_fleet_turns_per_dispatch_is_chunk_times_fuse(monkeypatch):
+    """stats()["fleet"] must report the EFFECTIVE dispatch granularity
+    (chunk_turns x fuse_k) — the number a capacity planner multiplies
+    by dispatch rate — and runs must still park bit-identical to the
+    torus replay at a target the granularity doesn't divide."""
+    import time
+
+    from gol_tpu.fleet.engine import FleetEngine
+
+    monkeypatch.setenv("GOL_FUSE_K", "3")
+    eng = FleetEngine(bucket_sizes=(64,), chunk_turns=2, slot_base=2)
+    try:
+        fl = eng.stats()["fleet"]
+        assert fl["fuse_k"] == 3
+        assert fl["turns_per_dispatch"] == 6
+        assert eng.turns_per_dispatch == 6
+        seed = _board01(64, 64, seed=21)
+        rec = eng.create_run(64, 64, board=seed * 255, run_id="fuse3",
+                             target_turn=8)   # 8 % 6 != 0: trim path
+        rv = eng.resolve_run(rec["run_id"])
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if rv.stats()["turn"] == 8 and rv.stats()["state"] == \
+                    "parked":
+                break
+            time.sleep(0.02)
+        got, turn = rv.get_world()
+        assert turn == 8
+        np.testing.assert_array_equal((got != 0).astype(np.uint8),
+                                      run_turns_np(seed, 8))
+    finally:
+        eng.kill_prog()
+
+
+def test_fleet_unfused_reports_identity(monkeypatch):
+    from gol_tpu.fleet.engine import FleetEngine
+
+    monkeypatch.delenv("GOL_FUSE_K", raising=False)
+    eng = FleetEngine(bucket_sizes=(64,), chunk_turns=2, slot_base=2)
+    try:
+        fl = eng.stats()["fleet"]
+        assert fl["fuse_k"] == 1
+        assert fl["turns_per_dispatch"] == eng.chunk_turns
+    finally:
+        eng.kill_prog()

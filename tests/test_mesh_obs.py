@@ -136,6 +136,40 @@ def test_eager_dispatch_counts_exact_analytic_traffic():
     assert cat.HALO_BYTES.labels(axis="rows").value - b0 == eb
 
 
+def test_fused_dispatch_counts_exact_analytic_traffic_at_k4():
+    """The fused (k=4) dispatcher against the same analytic model: a
+    pinned depth exchanges once per 4 turns (vs the naive 1/turn), and
+    the BYTES are conserved — a 4-deep exchange ships 2*4 halo rows per
+    macro, the same 2 rows/turn the depth-1 exchange ships. Counter
+    deltas, the per-turn gauges, and the fused-dispatch meter must all
+    agree with the model exactly."""
+    from gol_tpu.parallel.halo import fused_run_fn, halo_traffic
+    from gol_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    packed = _packed_on(mesh, 256, seed=2)
+    turns = 64
+    expected = halo_traffic("packed", tuple(packed.shape), mesh, turns,
+                            fuse=4)
+    er, eb = expected["rows"]
+    assert er == turns // 4          # one exchange round per macro-step
+    # byte conservation vs the unfused per-turn exchange: 2 rows/turn
+    # across 8 shard boundaries, 256 cells -> 8 words -> 32 B per row
+    assert eb == turns * 8 * 2 * 32
+    r0 = cat.HALO_EXCHANGES.labels(axis="rows").value
+    b0 = cat.HALO_BYTES.labels(axis="rows").value
+    f0 = cat.FUSED_DISPATCHES.labels(tier="mesh").value
+    np.asarray(fused_run_fn(4)(packed, turns, mesh))
+    assert cat.HALO_EXCHANGES.labels(axis="rows").value - r0 == er
+    assert cat.HALO_BYTES.labels(axis="rows").value - b0 == eb
+    assert cat.FUSED_DISPATCHES.labels(tier="mesh").value - f0 == 1
+    # per-turn gauges reflect THIS dispatch (set, not accumulated)
+    assert cat.HALO_EXCHANGES_PER_TURN.labels(axis="rows").value == \
+        pytest.approx(er / turns)
+    assert cat.HALO_BYTES_PER_TURN.labels(axis="rows").value == \
+        pytest.approx(eb / turns)
+
+
 def test_single_shard_dispatch_counts_nothing():
     from gol_tpu.parallel.halo import sharded_packed_run_turns
     from gol_tpu.parallel.mesh import make_mesh

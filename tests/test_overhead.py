@@ -207,6 +207,61 @@ def test_percentile_names_are_lower_is_better():
         "snapshot MB/s (512x512 loopback)", "MB/s")
 
 
+def test_audit_treats_removed_gated_entry_as_lowering(tmp_path,
+                                                      capsys):
+    """Deleting a gated anchor un-gates the metric entirely — the
+    stealthiest lowering of all. The removed entry cannot carry a
+    waiver, so the paper trail moves whole to CHANGES.md: the exact
+    metric name must appear there or the audit fails."""
+    metric = "cell-updates/sec (fused, k=4, 131072x131072)"
+    prev = str(tmp_path / "prev.json")
+    cur = str(tmp_path / "BASELINE.json")
+    cand = str(tmp_path / "cand.jsonl")
+    keep = "cell-updates/sec (fused, k=1, 131072x131072)"
+    with open(prev, "w") as f:
+        json.dump({"published": {
+            metric: {"value": 2.4e9, "unit": "cell-updates/s"},
+            keep: {"value": 1.1e9, "unit": "cell-updates/s"},
+        }}, f)
+    _baseline(cur, 1.1e9, unit="cell-updates/s", metric=keep)
+    _candidate(cand, 1.2e9, unit="cell-updates/s", metric=keep)
+    changes = tmp_path / "CHANGES.md"
+    changes.write_text("r99: unrelated note\n")
+    rc = perf_compare.main([cur, cand, "--baseline-prev", prev,
+                            "--changes", str(changes)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "removed from baseline" in out
+    # naming the removed metric in CHANGES.md restores the paper trail
+    changes.write_text(f"r99: retired {metric} with the fused tier\n")
+    rc = perf_compare.main([cur, cand, "--baseline-prev", prev,
+                            "--changes", str(changes)])
+    assert rc == 0
+    assert "removal noted in CHANGES.md" in capsys.readouterr().out
+
+
+def test_fused_metrics_match_gate_and_direction():
+    """The temporal-fusion families must be GATED by default, and the
+    per-turn halo observables are COSTS: exchanges/turn is the latency
+    exposure fusion divides by k, bytes/turn is conserved — a gate
+    that read either as higher-is-better would reward the exact
+    regression it exists to catch."""
+    import re
+
+    gate_re = re.compile(perf_compare.DEFAULT_GATE_PATTERN)
+    assert gate_re.search("cell-updates/sec (fused, k=16, "
+                          "131072x131072)")
+    assert gate_re.search("halo exchanges/turn (fused, k=4, 2-way)")
+    assert gate_re.search("halo bytes/turn (fused, k=8, 4-way)")
+    assert not perf_compare._higher_is_better(
+        "halo exchanges/turn (fused, k=4, 2-way)", "exchanges/turn")
+    assert not perf_compare._higher_is_better(
+        "halo bytes/turn (fused, k=4, 2-way)", "bytes/turn")
+    assert perf_compare._higher_is_better(
+        "cell-updates/sec (fused, k=16, 131072x131072)",
+        "cell-updates/s")
+
+
 def test_load_metrics_match_default_gate_pattern():
     """The rpc p50/p99 load metrics must be GATED by default, so
     `make load-smoke` can actually fail."""

@@ -1,0 +1,153 @@
+"""fuse-smoke: prove the temporal-fusion tier end to end, fast.
+
+Runs a reduced `bench.py --fuse` matrix IN-PROCESS on CPU (k ∈ {1, 4},
+one 512² dense board, one 2-way mesh leg), then validates every surface
+the fused tier is supposed to light up:
+
+  * every emitted leg parses and is parity-clean — each k is
+    bit-identical to the k=1 torus replay by construction of the gate;
+  * the analytic halo observables obey the physics: exchange ROUNDS
+    per turn at k=4 are exactly 1/4 (one exchange per macro-step) while
+    BYTES per turn are conserved across k (a k-deep exchange ships
+    2k rows per k turns — fusion cannot reduce bytes, only latency
+    exposure, and a smoke that "showed" shrinking bytes would be
+    measuring a bug);
+  * gol_fused_dispatches_total{tier="mesh"} and the per-turn halo
+    gauges hold real samples in the registry after the run;
+  * tools/perf_compare.py round-trips the captured lines against the
+    committed BASELINE.json entries (the same gate `make perf-gate`
+    runs on full bench artifacts).
+
+Exit 0 = pass.
+
+    make fuse-smoke     # part of the `make smoke` chain
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+from contextlib import redirect_stdout
+
+# Runnable as `python tools/fuse_smoke.py` from a bare clone: put the
+# repo root (this file's parent's parent) ahead of tools/ on sys.path.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The mesh legs need devices; force 8 virtual host devices strictly
+# before any jax backend initialisation (same guard as bench.py --fuse).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+FUSE_SMOKE_KS = (1, 4)
+FUSE_SMOKE_SIZE = 512
+FUSE_SMOKE_TURNS = 256
+FUSE_SMOKE_WAYS = (2,)
+FUSE_SMOKE_MESH_TURNS = 256
+
+
+def main() -> int:
+    import bench
+
+    problems = []
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.bench_fuse(ks=FUSE_SMOKE_KS,
+                              sizes=(FUSE_SMOKE_SIZE,),
+                              turns_override=FUSE_SMOKE_TURNS,
+                              ways=FUSE_SMOKE_WAYS,
+                              mesh_turns=FUSE_SMOKE_MESH_TURNS)
+    captured = buf.getvalue()
+    sys.stdout.write(captured)
+    if rc != 0:
+        problems.append(f"bench_fuse rc={rc} (parity gate failed?)")
+
+    # ---- bench lines ---------------------------------------------------
+    recs = []
+    for line in captured.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            recs.append(json.loads(line))
+        except ValueError:
+            problems.append(f"unparseable bench line: {line[:80]!r}")
+    by_name = {r.get("metric", ""): r for r in recs}
+    n = FUSE_SMOKE_SIZE
+    for needed in (
+            f"cell-updates/sec (fused, k=1, {n}x{n})",
+            f"cell-updates/sec (fused, k=4, {n}x{n})",
+            "cell-updates/sec (fused, k=1, 1024x1024 2-way)",
+            "cell-updates/sec (fused, k=4, 1024x1024 2-way)",
+            "halo exchanges/turn (fused, k=4, 2-way)",
+            "halo bytes/turn (fused, k=1, 2-way)",
+            "halo bytes/turn (fused, k=4, 2-way)"):
+        if needed not in by_name:
+            problems.append(f"missing bench line {needed!r}")
+    for r in recs:
+        if r.get("detail", {}).get("alive_parity") is not True:
+            problems.append(f"parity not clean on {r.get('metric')!r}")
+
+    # ---- the physics the gate encodes ----------------------------------
+    ex4 = by_name.get("halo exchanges/turn (fused, k=4, 2-way)", {})
+    if ex4 and ex4.get("value") != 0.25:
+        problems.append(f"k=4 exchange rounds/turn should be exactly "
+                        f"1/4, got {ex4.get('value')!r}")
+    b1 = by_name.get("halo bytes/turn (fused, k=1, 2-way)", {})
+    b4 = by_name.get("halo bytes/turn (fused, k=4, 2-way)", {})
+    if b1 and b4 and b1.get("value") != b4.get("value"):
+        problems.append(f"halo bytes/turn must be CONSERVED across k "
+                        f"(got k=1 {b1.get('value')!r} vs k=4 "
+                        f"{b4.get('value')!r})")
+
+    # ---- registry families hold real samples ---------------------------
+    from gol_tpu.obs.metrics import REGISTRY
+
+    samples = {}
+    for line in REGISTRY.render_prometheus().splitlines():
+        if line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            samples[key] = float(val)
+        except ValueError:
+            pass
+    for key in ('gol_fused_dispatches_total{tier="mesh"}',
+                'gol_halo_exchanges_per_turn{axis="rows"}',
+                'gol_halo_bytes_per_turn{axis="rows"}'):
+        if samples.get(key, 0) <= 0:
+            problems.append(f"registry sample not populated: {key!r} "
+                            f"= {samples.get(key)}")
+
+    # ---- perf_compare gate round-trip ----------------------------------
+    import perf_compare
+
+    tmpdir = tempfile.mkdtemp(prefix="gol_fuse_smoke_")
+    out_path = os.path.join(tmpdir, "fuse.jsonl")
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(captured)
+    if perf_compare.main([os.path.join(_ROOT, "BASELINE.json"),
+                          out_path]) != 0:
+        problems.append("perf_compare gate failed on the fused legs")
+
+    if problems:
+        for p in problems:
+            print(f"fuse-smoke: FAIL: {p}", file=sys.stderr)
+        return 1
+    legs = len(recs)
+    disp = int(samples.get('gol_fused_dispatches_total{tier="mesh"}',
+                           0))
+    print(f"fuse-smoke: OK — {legs} gated fused line(s), every k "
+          f"bit-identical to the k=1 replay, {disp} fused mesh "
+          f"dispatch(es) metered, bytes/turn conserved across k")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,7 +64,8 @@ DEFAULT_GATE_PATTERN = (
     r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us"
     r"|rpc p\d+ ms|efficiency_pct|fleet_scaling_efficiency_pct"
     r"|overlap_pct|availability_pct|retries_per_call"
-    r"|downtime_p\d+_ms|router_overhead_p\d+_ms")
+    r"|downtime_p\d+_ms|router_overhead_p\d+_ms"
+    r"|halo (?:bytes|exchanges)/turn")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
@@ -166,6 +167,14 @@ def _higher_is_better(metric: str, unit: Optional[str]) -> bool:
         return True
     if "retries" in low0:
         return False
+    # Temporal-fusion halo observables (the --fuse mesh legs): both are
+    # per-advanced-turn COSTS — exchanges/turn is the latency-exposure
+    # count fusion divides by k, bytes/turn is conserved (flat) — and
+    # neither unit ("exchanges/turn", "bytes/turn") hits any heuristic
+    # below, which would default them to higher-is-better and reward
+    # the exact regression the fused gate exists to catch.
+    if "bytes/turn" in low0 or "exchanges/turn" in low0:
+        return False
     if unit and (unit.endswith("/s") or unit.endswith("/sec")):
         return True
     if "/sec" in metric or "/s " in metric or "cups" in metric.lower():
@@ -255,9 +264,26 @@ def audit_baseline(cur_text: str, prev_text: str, gate_re,
         return None
     rows = []
     for metric in sorted(prev):
-        if metric not in cur or not gate_re.search(metric):
+        if not gate_re.search(metric):
             continue
         prev_v, prev_u = prev[metric]
+        if metric not in cur:
+            # A REMOVED gated entry is the stealthiest lowering of all
+            # (deleting the anchor un-gates the metric entirely), so it
+            # gets the same treatment as a lowered one. The entry is
+            # gone and cannot carry a waiver, so the paper trail moves
+            # whole to CHANGES.md: the exact metric name must appear
+            # there.
+            ok = bool(changes_text is not None
+                      and metric in changes_text)
+            rows.append({
+                "metric": metric, "unit": prev_u,
+                "previous": prev_v, "current": None,
+                "delta_pct": None, "waiver": None, "ok": ok,
+                "problem": None if ok else
+                "removed from baseline (name not in CHANGES.md)",
+            })
+            continue
         cur_v, cur_u = cur[metric]
         hib = _higher_is_better(metric, cur_u or prev_u)
         if (cur_v >= prev_v) if hib else (cur_v <= prev_v):
@@ -426,11 +452,16 @@ def main(argv=None) -> int:
                   "previous revision)")
             width = max(len(r["metric"]) for r in audit_rows)
             for r in audit_rows:
-                verdict = ("waived: " + r["waiver"] if r["ok"]
-                           else "FAIL: " + r["problem"])
+                if r["ok"]:
+                    verdict = ("waived: " + r["waiver"] if r["waiver"]
+                               else "removal noted in CHANGES.md")
+                else:
+                    verdict = "FAIL: " + r["problem"]
+                cur_s = ("(removed)" if r["current"] is None
+                         else f"{r['current']:.6g}")
                 print(f"  {r['metric']:<{width}}  "
                       f"{r['previous']:>14.6g} -> "
-                      f"{r['current']:>14.6g}  "
+                      f"{cur_s:>14}  "
                       f"{(r['delta_pct'] or 0):>+8.2f}%  {verdict}")
     for path in args.files[1:]:
         try:
