@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke fuse-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke fuse-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -59,6 +59,22 @@ load-smoke:
 	set -e; JAX_PLATFORMS=cpu python bench.py --load \
 		| tee out/load_smoke.jsonl
 	python tools/perf_compare.py BASELINE.json out/load_smoke.jsonl
+
+# Broadcast fan-out check, CPU-only: bench.py --broadcast parks 1000
+# Subscribe spectators (2 tracked decoders + a ViewerPool draining
+# bytes) on one advancing run behind the selectors gateway; the
+# encode_calls_per_published_frame witness must be exactly 1.0 and the
+# viewer_fanout_p99_ms ceiling gates via BASELINE.json.
+# tools/broadcast_smoke.py then proves encode-once, shared-byte
+# parity, the slow-subscriber skip-to-keyframe policy, DestroyRun
+# view-cache eviction + end sentinel, socket options, and the
+# gol_bcast_*/gol_gateway_* families end to end.
+broadcast-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --broadcast \
+		| tee out/broadcast_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/broadcast_smoke.jsonl
+	JAX_PLATFORMS=cpu python tools/broadcast_smoke.py
 
 # Chaos-hardening check, CPU-only: bench.py --chaos drives the same
 # seed twice over loopback TCP (clean, then under the seeded GOL_CHAOS
@@ -122,7 +138,7 @@ fuse-smoke:
 	JAX_PLATFORMS=cpu python tools/fuse_smoke.py
 
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke fuse-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke fuse-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

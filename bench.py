@@ -1745,6 +1745,230 @@ def bench_load(clients: int = LOAD_CLIENTS,
     return rc
 
 
+BCAST_VIEWERS = 1000
+BCAST_WINDOW_S = 3.0
+BCAST_BOARD = 64
+BCAST_TRACKED = 2
+
+
+def bench_broadcast(viewers: int = BCAST_VIEWERS,
+                    window_s: float = BCAST_WINDOW_S,
+                    n: int = BCAST_BOARD) -> int:
+    """Broadcast fan-out leg (PR 14): one continuously-advancing run,
+    `viewers` Subscribe spectators on the selectors gateway — 2
+    tracked ViewSubscription decoders (frame parity witnesses) plus a
+    mostly-idle ViewerPool draining pushed bytes without decoding (the
+    C10k shape). The measured window asserts the zero-work witness
+    EXACTLY — encode_calls_per_published_frame == 1.0, counter deltas
+    of gol_wire_encode_calls_total over gol_bcast_frames_total, i.e.
+    each published frame is encoded once no matter how many sockets it
+    fans out to — and lands the gateway's publish-to-socket-write
+    latency as the gated lower-is-better viewer_fanout_p99_ms line.
+    After the window the run is paused and force-published so a
+    tracked viewer's decoded frame is compared bit-for-bit against a
+    fresh per-viewer GetView at the same turn: the shared bytes must
+    be indistinguishable from the polling path they replace."""
+    import os
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import load_smoke
+
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.engine import FLAG_PAUSE
+    from gol_tpu.obs import catalog as obs
+    from gol_tpu.fleet import FleetEngine
+    from gol_tpu.obs import slo as obs_slo
+    from gol_tpu.server import EngineServer
+
+    for var in ("GOL_CKPT", "GOL_CKPT_EVERY_TURNS", "GOL_RULE",
+                "GOL_FLEET_BUCKETS", "GOL_FLEET_CHUNK",
+                "GOL_FLEET_SLOT_BASE", "GOL_FLEET_MEM_BUDGET",
+                "GOL_SLO_P99_MS", "GOL_BCAST_KEYFRAME",
+                "GOL_BCAST_RING", "GOL_BCAST_HZ", "GOL_GATEWAY_MAX"):
+        os.environ.pop(var, None)
+    obs_slo.reset()
+
+    # Every in-process viewer holds two fds (client socket + accepted
+    # server socket). Raise the soft RLIMIT_NOFILE to the hard cap
+    # (best-effort) and clamp the population to what fits.
+    soft = 1024
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < hard:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except Exception:  # noqa: BLE001 — platform-dependent, advisory
+        pass
+    budget = max(BCAST_TRACKED + 1, (soft - 256) // 2)
+    if viewers > budget:
+        print(f"BENCH NOTE: clamping --viewers {viewers} -> {budget} "
+              f"(RLIMIT_NOFILE soft={soft})", file=sys.stderr)
+        viewers = budget
+
+    view_cells = n * n
+    eng = FleetEngine(bucket_sizes=(n,), chunk_turns=2, slot_base=8)
+    srv = EngineServer(port=0, host="127.0.0.1", engine=eng)
+    srv.start_background()
+    address = f"127.0.0.1:{srv.port}"
+
+    tracked = []          # [(ViewSubscription, state dict)]
+    threads = []
+    pool = None
+    latest_lock = threading.Lock()
+
+    def _track(sub, state):
+        try:
+            for view, turn, (fy, fx), header in sub.frames(
+                    timeout=30.0):
+                with latest_lock:
+                    state["turn"] = turn
+                    state["view"] = view.copy()
+                    state["fy"], state["fx"] = fy, fx
+                    state["frames"] = state.get("frames", 0) + 1
+        except Exception as e:  # noqa: BLE001 — report via state
+            state["error"] = f"{type(e).__name__}: {e}"
+
+    try:
+        ctl = RemoteEngine(address, timeout=30.0)
+        rid = ctl.create_run(n, n)["run_id"]
+        bound = ctl.attach_run(rid)
+        for _ in range(BCAST_TRACKED):
+            sub = bound.subscribe(view_cells, timeout=30.0)
+            state = {"frames": 0}
+            th = threading.Thread(target=_track, args=(sub, state),
+                                  daemon=True)
+            th.start()
+            tracked.append((sub, state))
+            threads.append(th)
+        # Warm until every tracked decoder has a keyframe + a
+        # follow-up: the window below must measure fan-out, not the
+        # bucket program's first-chunk compile.
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            with latest_lock:
+                if all(s["frames"] >= 2 for _, s in tracked):
+                    break
+            time.sleep(0.05)
+        else:
+            print("BENCH LEG FAILED (broadcast): tracked viewers "
+                  "never warmed: "
+                  f"{[s for _, s in tracked]}", file=sys.stderr)
+            return 1
+        pool, errors = load_smoke.open_viewers(
+            address, viewers=viewers - BCAST_TRACKED, run_id=rid,
+            view_cells=view_cells, timeout=30.0)
+        if errors:
+            print(f"BENCH LEG FAILED (broadcast): {errors[:3]}",
+                  file=sys.stderr)
+            return 1
+
+        hub, gateway = srv._bcast
+        # Let the freshly-admitted population catch up to the stream
+        # head, then drop the catch-up samples: a frame pushed at
+        # attach time carries a publish timestamp that predates the
+        # subscriber, which is attach lag, not fan-out latency.
+        time.sleep(0.5)
+        gateway.fanout_reset()
+        e0 = obs.WIRE_ENCODE_CALLS.value
+        f0 = sum(ch.value
+                 for ch in obs.BCAST_FRAMES.children().values())
+        d0 = obs.BCAST_FRAMES_DROPPED.value
+        time.sleep(window_s)
+        e1 = obs.WIRE_ENCODE_CALLS.value
+        f1 = sum(ch.value
+                 for ch in obs.BCAST_FRAMES.children().values())
+        d1 = obs.BCAST_FRAMES_DROPPED.value
+        frames = f1 - f0
+        encodes = e1 - e0
+        if frames <= 0:
+            print("BENCH LEG FAILED (broadcast): no frames published "
+                  f"in the {window_s}s window", file=sys.stderr)
+            return 1
+        ratio = encodes / frames
+        pool_stats = pool.stats()
+
+        # Parity pin: pause, force one publish of the settled turn,
+        # then a tracked viewer's pushed frame must equal a fresh
+        # per-viewer GetView of the same turn, bit for bit.
+        bound.cf_put(FLAG_PAUSE)
+        ref, ref_turn, _ = bound.get_view(view_cells)
+        for _ in range(20):
+            out, turn, _ = bound.get_view(view_cells)
+            if turn == ref_turn:
+                break
+            ref, ref_turn = out, turn
+            time.sleep(0.05)
+        hub.publish_now(force=True)
+        parity = None
+        pin_deadline = time.monotonic() + 10.0
+        while time.monotonic() < pin_deadline:
+            with latest_lock:
+                got = tracked[0][1]
+                if got.get("turn") == ref_turn:
+                    parity = bool(np.array_equal(got["view"], ref))
+                    break
+            time.sleep(0.02)
+        if parity is not True:
+            with latest_lock:
+                got = {k: v for k, v in tracked[0][1].items()
+                       if k != "view"}
+            print("BENCH LEG FAILED (broadcast): pushed/polled parity "
+                  f"mismatch at turn {ref_turn}: parity={parity} "
+                  f"tracked={got}", file=sys.stderr)
+            return 1
+        snap = gateway.fanout_snapshot()
+    finally:
+        for sub, _ in tracked:
+            try:
+                sub.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if pool is not None:
+            pool.close()
+        for th in threads:
+            th.join(timeout=5.0)
+        eng.kill_prog()
+        srv.shutdown()
+
+    if pool_stats["closed"] or pool_stats["bytes"] <= 0:
+        print("BENCH LEG FAILED (broadcast): spectator pool unhealthy "
+              f"{pool_stats}", file=sys.stderr)
+        return 1
+    if ratio != 1.0:
+        print("BENCH LEG FAILED (broadcast): encode-once witness "
+              f"broken: {encodes} encode calls for {frames} published "
+              f"frames", file=sys.stderr)
+        return 1
+    if not snap or not snap.get("count"):
+        print("BENCH LEG FAILED (broadcast): gateway recorded no "
+              "fan-out samples", file=sys.stderr)
+        return 1
+
+    detail = {
+        "viewers": viewers, "tracked": BCAST_TRACKED, "board": n,
+        "window_s": window_s, "frames_published": frames,
+        "encode_calls": encodes, "frames_dropped": d1 - d0,
+        "pool_bytes": pool_stats["bytes"],
+        "fanout_samples": snap["count"],
+        "parity": "pushed frame bit-identical to per-viewer GetView "
+                  "at the pinned turn",
+        "method": "counter deltas over the measured window of an "
+                  "in-process fleet server; fan-out latency is "
+                  "publish-to-socket-write-completion per frame per "
+                  "subscriber on the gateway's selectors loop",
+    }
+    _emit("encode_calls_per_published_frame (broadcast)", ratio,
+          "calls/frame", None, detail)
+    _emit("viewer_fanout_p99_ms (broadcast)",
+          round(snap["p99"] * 1e3, 3), "ms", None,
+          dict(detail, p50_ms=round(snap["p50"] * 1e3, 3),
+               p95_ms=round(snap["p95"] * 1e3, 3)))
+    return 0
+
+
 CHAOS_BOARD = 128
 CHAOS_TURNS = 96
 # ~2% hard-fault rate per wire hook draw (drop+truncate+corrupt), plus
@@ -2228,6 +2452,21 @@ def main() -> int:
                     metavar="N",
                     help="with --load: cycles per client (default "
                          f"{LOAD_CYCLES})")
+    ap.add_argument("--broadcast", action="store_true",
+                    help="run the broadcast fan-out leg only: one "
+                         "advancing run pushed to N Subscribe "
+                         "spectators through the selectors gateway "
+                         "(emits the gated "
+                         "encode_calls_per_published_frame / "
+                         "viewer_fanout_p99_ms lines)")
+    ap.add_argument("--viewers", type=int, default=None, metavar="N",
+                    help="with --broadcast: subscriber population "
+                         f"(default {BCAST_VIEWERS}; 10k+ on demand, "
+                         "clamped to RLIMIT_NOFILE)")
+    ap.add_argument("--bcast-window", type=float, default=None,
+                    metavar="SEC",
+                    help="with --broadcast: measured fan-out window "
+                         f"(default {BCAST_WINDOW_S}s)")
     ap.add_argument("--chaos", action="store_true",
                     help="run the chaos availability leg only: the "
                          "same wire-driven run clean and under a "
@@ -2503,6 +2742,27 @@ def _dispatch(args, ap) -> int:
                     else LOAD_CYCLES))
     if args.load_clients is not None or args.load_cycles is not None:
         ap.error("--load-clients/--load-cycles apply to the --load "
+                 "leg only")
+
+    if args.broadcast:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.chaos or args.size is not None:
+            ap.error("--broadcast is its own config; combine only "
+                     "with --viewers/--bcast-window")
+        if args.viewers is not None and args.viewers <= BCAST_TRACKED:
+            ap.error(f"--viewers wants > {BCAST_TRACKED} subscribers "
+                     f"({BCAST_TRACKED} tracked decoders + idle "
+                     "spectators)")
+        if args.bcast_window is not None and args.bcast_window <= 0:
+            ap.error("--bcast-window wants positive seconds")
+        return bench_broadcast(
+            viewers=(args.viewers if args.viewers is not None
+                     else BCAST_VIEWERS),
+            window_s=(args.bcast_window if args.bcast_window
+                      else BCAST_WINDOW_S))
+    if args.viewers is not None or args.bcast_window is not None:
+        ap.error("--viewers/--bcast-window apply to the --broadcast "
                  "leg only")
 
     if args.chaos:

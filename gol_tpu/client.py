@@ -454,6 +454,37 @@ class RemoteEngine:
             self._view_basis = (turn, fy, fx, view)
         return view, turn, (fy, fx)
 
+    def subscribe(self, max_cells: int,
+                  timeout: float = None) -> "ViewSubscription":
+        """Upgrade one connection to a server-push live-view stream
+        (the broadcast tier): the server ACKs, then pushes epoch-stream
+        frames — one keyframe every `keyframe_every` frames plus xrle
+        deltas against the previous pushed frame — until either side
+        hangs up. Unlike get_view polling, N subscribers of one run
+        cost the server ONE encode per published frame.
+
+        Requires full codec caps (every subscriber shares the same
+        frozen bytes); servers refuse partial-caps peers with an error
+        — fall back to get_view polling then."""
+        header = {"method": "Subscribe", "max_cells": int(max_cells),
+                  "vkey": self._token,
+                  "caps": sorted(wire.local_caps())}
+        if self.run_id is not None:
+            header["run_id"] = self.run_id
+        to = self._timeout if timeout is None else timeout
+        sock = _dial(self._addr, to)
+        try:
+            wire.enable_nodelay(sock)
+            sock.settimeout(to)
+            send_msg(sock, header)
+            resp, _ = recv_msg(sock)
+            self._note_caps(resp)
+            _check_resp(resp)
+        except BaseException:
+            sock.close()
+            raise
+        return ViewSubscription(sock, resp)
+
     def get_window(self):
         """Sparse engines: (window pixels, (ox, oy) torus origin, turn)."""
         resp, world = self._call({"method": "GetWindow"},
@@ -575,3 +606,73 @@ class RemoteEngine:
 
     def kill_prog(self) -> None:
         self._call({"method": "KillProg"}, timeout=self._timeout)
+
+
+class ViewSubscription:
+    """Consumer half of a Subscribe upgrade: a persistent socket the
+    server pushes epoch-stream frames down.
+
+    `recv()` blocks for the next frame and maintains the xrle basis
+    chain automatically: keyframes decode standalone, deltas decode
+    against the previous received frame. After the gateway skips this
+    subscriber forward (it was too slow), the next frame is a keyframe
+    by protocol, so the chain re-anchors without any client logic.
+    The stream ends with a ConnectionError carrying the server's end
+    sentinel (run destroyed, server shutdown) or a raw hangup."""
+
+    def __init__(self, sock: socket.socket, ack: dict) -> None:
+        self._sock = sock
+        self.run_id = ack.get("run_id")
+        self.epoch = int(ack.get("epoch", 0))
+        self.keyframe_every = int(ack.get("keyframe_every", 0))
+        self.max_cells = int(ack.get("max_cells", 0))
+        self._basis = None  # (turn, pixels) — the last received frame
+        self.frames_received = 0
+        self.closed = False
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def recv(self, timeout: float = None):
+        """Block for the next pushed frame; returns
+        (view pixels, turn, (fy, fx), header). Raises ConnectionError
+        when the stream ends (the exception message carries the
+        server's end-sentinel reason when one was sent). ANY failure —
+        including a recv timeout — closes the subscription: a frame
+        may have been half-consumed, and the push framing is not
+        resumable mid-message. Re-subscribe to continue (the first
+        frame is always a keyframe, so nothing is lost but time)."""
+        if self.closed:
+            raise ConnectionError("subscription closed")
+        self._sock.settimeout(timeout)
+        try:
+            header, view = recv_msg(self._sock, xrle_basis=self._basis)
+        except BaseException:
+            self.close()
+            raise
+        if header.get("push") == "end" or not header.get("ok", False):
+            self.close()
+            raise ConnectionError(
+                f"stream ended: {header.get('error', 'closed by server')}")
+        turn = int(header["turn"])
+        self.epoch = int(header.get("epoch", self.epoch))
+        if view is not None:
+            self._basis = (turn, view)
+        self.frames_received += 1
+        return view, turn, (int(header["fy"]), int(header["fx"])), header
+
+    def frames(self, timeout: float = None):
+        """Yield (view, turn, (fy, fx), header) until the stream ends
+        (a clean end sentinel returns; transport errors propagate)."""
+        while True:
+            try:
+                yield self.recv(timeout)
+            except ConnectionError:
+                return
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass

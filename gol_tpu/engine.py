@@ -578,6 +578,12 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
         # Mesh geometry of the most recently submitted run
         # (parallel.mesh.mesh_geometry dict), for stats()/Stats.
         self._mesh_geom: Optional[dict] = None
+        # Broadcast publish hook (gol_tpu/broadcast.py): invoked once
+        # per retired chunk, no arguments, must be cheap and never
+        # raise (the hub installs threading.Event.set). None = no
+        # broadcast tier attached, and the attribute read is the only
+        # per-chunk cost.
+        self._bcast_notify = None
 
     def _publish_locked(self, alive: int, turn: int,
                         reset_floor: bool = False) -> None:
@@ -1231,6 +1237,9 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                 with self._state_lock:
                     self._cells = cells
                     self._turn += k
+                cb = self._bcast_notify
+                if cb is not None:
+                    cb()
                 if (next_ckpt_turn is not None
                         and self._turn >= next_ckpt_turn):
                     _ckpt_submit(cells, "periodic")

@@ -214,6 +214,9 @@ class FleetEngine(ControlFlagProtocol):
         self._waitq: deque = deque()        # beyond capacity, queued
         self._run_seq = 0
         self._loop_thread: Optional[threading.Thread] = None
+        # Broadcast publish hook: poked once per serving quantum (same
+        # contract as Engine._bcast_notify — cheap, never raises).
+        self._bcast_notify = None
 
         # Telemetry (legacy stats keys + the fleet bench counters).
         self._turns_per_s = 0.0
@@ -1231,6 +1234,9 @@ class FleetEngine(ControlFlagProtocol):
                         runs=len(stepped), run_ids=run_ids,
                         alive=int(alive_host.sum()))
                 self._wake.notify_all()
+            cb = self._bcast_notify
+            if cb is not None:
+                cb()
             now = time.monotonic()
             if now - last_flush >= METRICS_FLUSH_SECONDS:
                 with self._wake:

@@ -19,7 +19,7 @@ WIRE_METHODS = (
     "CFput", "DrainFlags", "KillProg", "Ping", "Stats", "AbortRun",
     "GetMetrics", "Checkpoint", "RestoreRun", "Profile",
     "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "SetRule",
-    "RegisterMember", "AdoptRun",
+    "RegisterMember", "AdoptRun", "Subscribe",
     "unknown",
 )
 
@@ -460,6 +460,66 @@ FED_ROUTER_OVERHEAD_MS = REGISTRY.gauge(
     label_names=("q",))
 for _q in SLO_QUANTILES:
     FED_ROUTER_OVERHEAD_MS.labels(q=_q)
+
+
+# ------------------------------------------------- broadcast tier & gateway
+
+# Deliberately run_id-free: one scalar per family. 100k spectators of
+# one viral run must not become 100k label children, and per-run detail
+# already lives on /healthz — same cardinality discipline as the fleet
+# staleness gauges.
+BCAST_STREAMS = REGISTRY.gauge(
+    "gol_bcast_streams",
+    "Epoch broadcast streams currently open on this server (one per "
+    "(run, view geometry) with at least one subscriber ever; dropped "
+    "with the run).")
+BCAST_SUBSCRIBERS = REGISTRY.gauge(
+    "gol_bcast_subscribers",
+    "Viewer sockets currently attached to any broadcast stream "
+    "(Subscribe upgrades adopted by the gateway event loop). "
+    "Deliberately run_id-free — bounded cardinality regardless of how "
+    "many runs or viewers exist.")
+GATEWAY_CONNECTIONS = REGISTRY.gauge(
+    "gol_gateway_connections",
+    "Sockets currently owned by the selectors-based viewer gateway "
+    "(subscribers plus connections mid-adoption); capped by "
+    "GOL_GATEWAY_MAX.")
+
+BCAST_FRAMES = REGISTRY.counter(
+    "gol_bcast_frames_total",
+    "Frames published into broadcast epoch streams, by kind: key "
+    "(standalone keyframe — plain codec, decodable with no basis) or "
+    "delta (xrle against the shared epoch basis). Each published frame "
+    "is encoded exactly once no matter how many subscribers consume "
+    "it: gol_wire_encode_calls_total advances by exactly 1 per "
+    "publication (the bench.py --broadcast zero-work witness).",
+    label_names=("kind",))
+for _k in ("key", "delta"):
+    BCAST_FRAMES.labels(kind=_k)
+
+BCAST_FRAMES_DROPPED = REGISTRY.counter(
+    "gol_bcast_frames_dropped_total",
+    "Frames a slow subscriber never received because the stream ring "
+    "overtook it and the gateway skipped it forward to the newest "
+    "keyframe (the drop policy that keeps one stalled socket from "
+    "backpressuring the ring, other subscribers, or the engine chunk "
+    "loop).")
+BCAST_SENT_BYTES = REGISTRY.counter(
+    "gol_bcast_sent_bytes_total",
+    "Bytes the gateway pushed to subscribers (every socket counts the "
+    "shared frame bytes it actually received). Fan-out cost of the "
+    "broadcast tier; the encode cost is in gol_wire_frame_bytes_total "
+    "exactly once per published frame.")
+
+BCAST_FANOUT_MS = REGISTRY.gauge(
+    "gol_bcast_fanout_ms",
+    "Fan-out latency quantiles in milliseconds from a log-bucket "
+    "estimator (obs/slo.py): publication of a frame into the stream "
+    "ring -> the last byte of that frame handed to a subscriber's "
+    "socket, one sample per (frame, subscriber) delivery.",
+    label_names=("q",))
+for _q in SLO_QUANTILES:
+    BCAST_FANOUT_MS.labels(q=_q)
 
 
 # ------------------------------------------------- tracing / flight recorder

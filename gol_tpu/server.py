@@ -93,6 +93,16 @@ class EngineServer:
         # In-flight handler census for graceful drain.
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # Broadcast tier (hub + selectors gateway): created lazily on
+        # the first Subscribe so servers that never see one spawn no
+        # extra threads. (hub, gateway) once armed; guarded by _bcast
+        # being written last.
+        self._bcast = None
+        self._bcast_lock = threading.Lock()
+        # Per-thread flag a Subscribe handler sets after handing its
+        # socket to the gateway: _serve_conn's finally must then NOT
+        # close the fd the event loop now owns.
+        self._adopted_conn = threading.local()
 
     VIEW_CACHE_MAX = 4
     DEDUPE_MAX = 512
@@ -156,6 +166,14 @@ class EngineServer:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        bc = self._bcast
+        if bc is not None:
+            hub, gateway = bc
+            try:
+                hub.stop()
+                gateway.stop()
+            except Exception:  # noqa: BLE001 — shutdown must not raise
+                pass
         try:
             self._sock.close()
         except OSError:
@@ -216,10 +234,16 @@ class EngineServer:
                 # A handler bug must not leak the fd or die silently.
                 obs_exception("server.handler_crashed", e, method=label)
         finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            if getattr(self._adopted_conn, "flag", False):
+                # Subscribe upgrade: the gateway event loop owns this
+                # fd now — closing it here would hang up the viewer the
+                # handler just ACKed.
+                self._adopted_conn.flag = False
+            else:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _dispatch(
         self, conn: socket.socket, header: dict, world,
@@ -366,6 +390,45 @@ class EngineServer:
             return f"{header['run_id']}|{vkey}"
         return vkey
 
+    def _drop_run_views(self, run_id: str) -> None:
+        """DestroyRun eviction: every `run_id|vkey` basis entry of the
+        destroyed run must go, not just one key — a recycled run_id
+        would otherwise delta a NEW run's first frame against the DEAD
+        run's last one, and the leaked entries pin dead boards in the
+        LRU until unrelated viewers push them out."""
+        if not run_id:
+            return
+        prefix = f"{run_id}|"
+        with self._view_cache_lock:
+            for k in [k for k in self._view_cache if k.startswith(prefix)]:
+                del self._view_cache[k]
+
+    def _ensure_broadcast(self):
+        """The lazily-armed (hub, gateway) pair. First Subscribe starts
+        the publisher + event-loop threads and installs the hub's poke
+        as the engine's per-chunk publish hook; servers that never see
+        a Subscribe never pay for either."""
+        bc = self._bcast
+        if bc is not None:
+            return bc
+        with self._bcast_lock:
+            if self._bcast is None:
+                from gol_tpu.broadcast import BroadcastHub
+                from gol_tpu.gateway import ViewerGateway
+                hub = BroadcastHub()
+                gateway = ViewerGateway()
+                hub.start(sink=gateway.notify)
+                gateway.start()
+                try:
+                    # Duck-typed: engines carry _bcast_notify = None;
+                    # anything else simply never pokes and streams ride
+                    # the hub's GOL_BCAST_HZ pacing tick alone.
+                    self.engine._bcast_notify = hub.poke
+                except Exception:  # noqa: BLE001
+                    pass
+                self._bcast = (hub, gateway)
+        return self._bcast
+
     def _drop_view_basis(self, header: dict) -> None:
         """Invalidate a viewer's basis-cache entry after a reply failed
         mid-send: the viewer never received the frame we just recorded
@@ -386,6 +449,7 @@ class EngineServer:
     RUN_SCOPED = frozenset({
         "ServerDistributor", "Ping", "Stats", "Alivecount", "GetWorld",
         "GetView", "GetWindow", "CFput", "DrainFlags", "Checkpoint",
+        "Subscribe",
     })
 
     def _resolve_target(self, method, header: dict):
@@ -468,6 +532,43 @@ class EngineServer:
                 except (ConnectionError, OSError):
                     self._drop_view_basis(header)
                     raise
+            elif method == "Subscribe":
+                # Broadcast upgrade: ACK on the threaded path, then
+                # hand the socket to the selectors gateway — this
+                # handler (and its conn slot) returns immediately while
+                # the gateway pushes encode-once epoch-stream frames
+                # until the peer hangs up. Requires the peer to have
+                # negotiated every stream codec: all subscribers share
+                # the same frozen bytes, so a partial-caps peer must
+                # poll per-viewer GetView instead.
+                if not hasattr(eng, "get_view"):
+                    raise RuntimeError("engine has no live view surface")
+                vkey = header.get("vkey")
+                if (hasattr(eng, "subscribe_view")
+                        and isinstance(vkey, str) and 0 < len(vkey) <= 64):
+                    eng.subscribe_view(vkey)
+                hub, gateway = self._ensure_broadcast()
+                stream = hub.stream_for(
+                    str(header.get("run_id") or ""), eng,
+                    int(header.get("max_cells", 0)))
+                if not stream.caps <= caps:
+                    raise RuntimeError(
+                        "subscribe requires caps "
+                        f"{sorted(stream.caps)}; poll GetView instead")
+                if not gateway.try_reserve():
+                    raise RuntimeError(
+                        "overloaded: gateway connection limit")
+                try:
+                    self._reply(conn, {
+                        "ok": True, "run_id": stream.run_id or None,
+                        "epoch": stream.epoch,
+                        "keyframe_every": stream.keyframe_every,
+                        "max_cells": stream.max_cells})
+                except BaseException:
+                    gateway.release_reservation()
+                    raise
+                gateway.adopt(conn, stream)
+                self._adopted_conn.flag = True
             elif method == "GetWindow":
                 # Sparse engines only: live-window pixels + torus origin.
                 out, (ox, oy), turn = eng.get_window()
@@ -520,8 +621,15 @@ class EngineServer:
                 # frees its admission budget, and wakes the loop so a
                 # queued run promotes immediately. Single-run engines
                 # answer FleetUnsupported, same as CreateRun.
-                rec = self.engine.destroy_run(
-                    str(header.get("run_id") or ""))
+                rid = str(header.get("run_id") or "")
+                rec = self.engine.destroy_run(rid)
+                # The run is gone: purge every per-viewer xrle basis in
+                # its namespace and end its broadcast streams (each
+                # subscriber gets the end sentinel, then a hangup).
+                self._drop_run_views(rid)
+                bc = self._bcast
+                if bc is not None:
+                    bc[0].drop_run(rid, f"killed: run {rid} destroyed")
                 self._reply(conn, {"ok": True, "run": rec})
             elif method == "SetRule":
                 # Rule migration: evict -> re-home under the new rule's

@@ -870,6 +870,45 @@ def frame_header(header: dict) -> bytes:
     return _LEN.pack(len(raw)) + raw
 
 
+def freeze_message(header: dict, frame: Optional[Frame] = None) -> bytes:
+    """Materialize one complete message (framed header + the frame's
+    payload) into a single ready-to-send bytes object.
+
+    This is the broadcast tier's half of send_msg: the epoch stream
+    freezes each published frame ONCE and the gateway then writes the
+    same immutable buffer to every subscriber socket, so fan-out cost
+    is sendmsg syscalls, not re-encoding. The encode-side frame
+    families (gol_wire_frames_total, frame bytes/saved/ratio, encode
+    seconds) are metered here exactly once per freeze; the per-send
+    byte accounting is the sender's job (the gateway batches it into
+    gol_wire_bytes_total / gol_bcast_sent_bytes_total)."""
+    if frame is None:
+        return frame_header(header)
+    header = dict(header)
+    header["world"] = frame.meta()
+    head = frame_header(header)
+    parts = [head]
+    paid = 0
+    for chunk in frame.chunks:
+        mv = memoryview(chunk)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        parts.append(bytes(mv))
+        paid += mv.nbytes
+    if paid != frame.nbytes:
+        raise RuntimeError(
+            f"frame chunks produced {paid} bytes, header promised "
+            f"{frame.nbytes}")
+    _FRAMES[frame.codec].inc()
+    _FRAME_BYTES[frame.codec].inc(frame.nbytes)
+    if frame.raw_nbytes > frame.nbytes:
+        obs.WIRE_BYTES_SAVED.inc(frame.raw_nbytes - frame.nbytes)
+    if frame.nbytes:
+        obs.WIRE_COMPRESSION_RATIO.set(frame.raw_nbytes / frame.nbytes)
+    _ENCODE_SECONDS[frame.codec].observe(frame.encode_s)
+    return b"".join(parts)
+
+
 def relay_payload(src: socket.socket, dst: socket.socket,
                   nbytes: int, chunk: int = 1 << 20) -> None:
     """Stream exactly `nbytes` of payload from src to dst, verbatim."""

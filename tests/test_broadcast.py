@@ -1,0 +1,396 @@
+"""Broadcast fan-out tier: EpochStream unit tests plus end-to-end
+Subscribe/gateway coverage against an in-process fleet server.
+
+The tier's contract, tested here:
+
+  * encode-once — publishing a frame costs exactly one wire encode no
+    matter how many subscribers the gateway fans it out to;
+  * keyframe cadence — a keyframe every GOL_BCAST_KEYFRAME frames,
+    xrle deltas between, epoch bump + forced keyframe on basis
+    invalidation (turn regression / geometry change);
+  * slow subscribers skip forward to a keyframe with drops metered,
+    never backpressuring the publisher or other subscribers;
+  * DestroyRun evicts every run-scoped view-cache basis entry and
+    delivers the end sentinel to subscribers;
+  * gateway-adopted sockets carry TCP_NODELAY + SO_KEEPALIVE.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import wire
+from gol_tpu.broadcast import BcastFrame, BroadcastHub, EpochStream
+from gol_tpu.client import RemoteEngine
+from gol_tpu.engine import FLAG_PAUSE
+from gol_tpu.obs import catalog as obs
+
+BOARD = 32
+VIEW_CELLS = BOARD * BOARD
+
+
+class FakeSurface:
+    """Deterministic publish surface: each turn flips one cell."""
+
+    binary_pixels = True
+    frames_diffable = True
+
+    def __init__(self, n: int = BOARD) -> None:
+        self.n = n
+        self.turn = 0
+        self.pixels = np.zeros((n, n), dtype=np.uint8)
+        self.fy = self.fx = 1
+
+    def advance(self, turns: int = 1) -> None:
+        for _ in range(turns):
+            self.turn += 1
+            i = self.turn % (self.n * self.n)
+            self.pixels.flat[i] ^= 1
+
+    def ping(self) -> int:
+        return self.turn
+
+    def get_view(self, max_cells: int):
+        return self.pixels.copy(), self.turn, (self.fy, self.fx)
+
+
+def _decode(raw: bytes, basis=None):
+    """Decode one frozen wire message through a real socket pair."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(raw)
+        a.shutdown(socket.SHUT_WR)
+        return wire.recv_msg(b, xrle_basis=basis)
+    finally:
+        a.close()
+        b.close()
+
+
+def _stream(monkeypatch, keyframe=4, ring=0, hz=1e6) -> EpochStream:
+    monkeypatch.setenv("GOL_BCAST_KEYFRAME", str(keyframe))
+    if ring:
+        monkeypatch.setenv("GOL_BCAST_RING", str(ring))
+    monkeypatch.setenv("GOL_BCAST_HZ", str(hz))
+    return EpochStream("runA", FakeSurface(), VIEW_CELLS)
+
+
+def test_keyframe_cadence(monkeypatch):
+    st = _stream(monkeypatch, keyframe=4)
+    surf = st._surface
+    kinds = []
+    for _ in range(10):
+        surf.advance()
+        bf = st.publish(force=True)
+        assert isinstance(bf, BcastFrame)
+        kinds.append(bf.key)
+    # K D D D D K D D D D: a keyframe, keyframe_every deltas, repeat.
+    assert kinds == [True, False, False, False, False,
+                     True, False, False, False, False]
+
+
+def test_frames_decode_along_the_basis_chain(monkeypatch):
+    st = _stream(monkeypatch, keyframe=4)
+    surf = st._surface
+    basis = None
+    for i in range(7):
+        surf.advance()
+        bf = st.publish(force=True)
+        header, view = _decode(bf.raw, basis=basis)
+        assert header["ok"] and header["push"] == "frame"
+        assert header["seq"] == i and header["turn"] == surf.turn
+        assert header["key"] == bf.key
+        assert header["world"]  # frame meta rides every push
+        # binary surfaces decode as 0/255 — compare aliveness masks
+        assert np.array_equal(view != 0, surf.pixels != 0)
+        basis = (surf.turn, view)
+
+
+def test_repeated_turn_publishes_without_reencoding(monkeypatch):
+    st = _stream(monkeypatch, keyframe=4)
+    surf = st._surface
+    surf.advance()
+    first = st.publish(force=True)
+    calls = obs.WIRE_ENCODE_CALLS.value
+    again = st.publish(force=True)  # same turn: ring tail, no encode
+    assert again is first
+    assert obs.WIRE_ENCODE_CALLS.value == calls
+
+
+def test_pacing_and_idle_probe(monkeypatch):
+    st = _stream(monkeypatch, keyframe=4, hz=10.0)
+    surf = st._surface
+    surf.advance()
+    assert st.publish(now=100.0) is not None
+    surf.advance()
+    assert st.publish(now=100.01) is None      # inside 1/hz: paced off
+    assert st.publish(now=101.0) is not None   # due again
+    assert st.publish(now=102.0) is None       # idle turn: ping() short-circuits
+
+
+def test_ring_eviction_resyncs_at_a_keyframe(monkeypatch):
+    st = _stream(monkeypatch, keyframe=4, ring=6)
+    surf = st._surface
+    for _ in range(20):
+        surf.advance()
+        st.publish(force=True)
+    # A subscriber parked at seq 0 fell out of the ring: it must be
+    # handed the newest keyframe, with the gap metered as skips.
+    frame, skipped = st.next_frame(0)
+    assert frame.key
+    assert frame is st._latest_key
+    assert skipped == frame.seq
+    # attach() starts new subscribers at that same keyframe.
+    assert st.attach() == frame.seq
+    st.detach()
+    # Caught-up subscribers see None, not a stale frame.
+    assert st.next_frame(st._seq) is None
+
+
+def test_epoch_bumps_on_basis_invalidation(monkeypatch):
+    st = _stream(monkeypatch, keyframe=100)
+    surf = st._surface
+    surf.advance(3)
+    st.publish(force=True)
+    surf.advance()
+    assert not st.publish(force=True).key  # mid-chain: a delta
+    surf.turn = 1  # turn regression (reset/restore): basis is dead
+    bf = st.publish(force=True)
+    assert bf.key and st.epoch == 1
+    surf.advance()
+    surf.fy = 2  # geometry change: same story
+    bf = st.publish(force=True)
+    assert bf.key and st.epoch == 2
+
+
+def test_close_rings_the_end_sentinel(monkeypatch):
+    st = _stream(monkeypatch)
+    surf = st._surface
+    surf.advance()
+    st.publish(force=True)
+    st.close("killed: gone")
+    frame, _ = st.next_frame(st._seq - 1)
+    assert frame.end
+    header, view = _decode(frame.raw)
+    assert header == {"ok": False, "push": "end", "seq": 1,
+                      "error": "killed: gone"}
+    assert view is None
+    surf.advance()
+    assert st.publish(force=True) is None  # closed: refuses publishes
+
+
+def test_hub_streams_are_shared_and_droppable(monkeypatch):
+    monkeypatch.setenv("GOL_BCAST_KEYFRAME", "4")
+    hub = BroadcastHub()
+    surf = FakeSurface()
+    a = hub.stream_for("runA", surf, VIEW_CELLS)
+    assert hub.stream_for("runA", surf, VIEW_CELLS) is a
+    assert hub.stream_for("runA", surf, 16) is not a  # other geometry
+    hub.drop_run("runA", "killed: destroyed")
+    assert a.closed
+    b = hub.stream_for("runA", surf, VIEW_CELLS)
+    assert b is not a  # closed streams are replaced, not resurrected
+
+
+# --------------------------------------------------------------- e2e
+
+
+@pytest.fixture()
+def bcast_server(monkeypatch):
+    monkeypatch.setenv("GOL_BCAST_KEYFRAME", "4")
+    monkeypatch.setenv("GOL_BCAST_RING", "8")
+    monkeypatch.setenv("GOL_BCAST_HZ", "100")
+    from gol_tpu.fleet import FleetEngine
+    from gol_tpu.server import EngineServer
+
+    eng = FleetEngine(bucket_sizes=(BOARD,), chunk_turns=2, slot_base=8)
+    srv = EngineServer(port=0, host="127.0.0.1", engine=eng)
+    srv.start_background()
+    try:
+        yield srv, f"127.0.0.1:{srv.port}"
+    finally:
+        eng.kill_prog()
+        srv.shutdown()
+
+
+def _recv_until(sub, pred, deadline_s=60.0):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        last = sub.recv(timeout=30.0)
+        if pred(last):
+            return last
+    raise AssertionError(f"condition never met; last frame {last!r}")
+
+
+def test_subscribe_e2e_parity_encode_once_and_destroy(bcast_server):
+    srv, address = bcast_server
+    ctl = RemoteEngine(address, timeout=30.0)
+    rid = ctl.create_run(BOARD, BOARD)["run_id"]
+    bound = ctl.attach_run(rid)
+    sub1 = bound.subscribe(VIEW_CELLS, timeout=30.0)
+    sub2 = bound.subscribe(VIEW_CELLS, timeout=30.0)
+    try:
+        assert sub1.run_id == rid and sub1.keyframe_every == 4
+        # Both subscribers decode the shared frames independently.
+        _recv_until(sub1, lambda f: f[3]["seq"] >= 2)
+        _recv_until(sub2, lambda f: f[3]["seq"] >= 2)
+
+        # Encode-once witness over a live window: wire encodes advance
+        # exactly as much as published broadcast frames (two
+        # subscribers are attached, so per-viewer encodes would 2x it).
+        e0 = obs.WIRE_ENCODE_CALLS.value
+        f0 = sum(c.value for c in obs.BCAST_FRAMES.children().values())
+        drained = 0
+        while drained < 6:
+            sub1.recv(timeout=30.0)
+            sub2.recv(timeout=30.0)
+            drained += 1
+        e1 = obs.WIRE_ENCODE_CALLS.value
+        f1 = sum(c.value for c in obs.BCAST_FRAMES.children().values())
+        assert f1 - f0 > 0
+        assert e1 - e0 == f1 - f0
+
+        # Adopted sockets carry TCP_NODELAY + SO_KEEPALIVE.
+        hub, gateway = srv._bcast
+        gsubs = list(gateway._subs.values())
+        assert len(gsubs) == 2
+        for gs in gsubs:
+            assert gs.sock.getsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY)
+            assert gs.sock.getsockopt(socket.SOL_SOCKET,
+                                      socket.SO_KEEPALIVE)
+        assert obs.BCAST_SUBSCRIBERS.value >= 0  # gauge exists, run_id-free
+        assert obs.BCAST_FRAMES.label_names == ("kind",)
+
+        # Pushed frames are bit-identical to the per-viewer GetView
+        # path at the same turn (pause to pin it).
+        bound.cf_put(FLAG_PAUSE)
+        ref, ref_turn, _ = bound.get_view(VIEW_CELLS)
+        for _ in range(50):
+            out, turn, _ = bound.get_view(VIEW_CELLS)
+            if turn == ref_turn:
+                break
+            ref, ref_turn = out, turn
+            time.sleep(0.02)
+        hub.publish_now(force=True)
+        view, turn, _geom, header = _recv_until(
+            sub1, lambda f: f[1] >= ref_turn, deadline_s=10.0)
+        assert turn == ref_turn
+        assert np.array_equal(view, ref)
+
+        # DestroyRun: end sentinel reaches the subscriber with the
+        # reason, and the run's view-cache basis entries are gone.
+        with srv._view_cache_lock:
+            assert any(k.startswith(f"{rid}|") for k in srv._view_cache)
+        ctl.destroy_run(rid)
+        with pytest.raises(ConnectionError, match="destroyed"):
+            for _ in range(200):
+                sub1.recv(timeout=10.0)
+        with srv._view_cache_lock:
+            assert not any(k.startswith(f"{rid}|")
+                           for k in srv._view_cache)
+    finally:
+        sub1.close()
+        sub2.close()
+
+
+def test_slow_subscriber_skips_without_stalling_others(bcast_server):
+    srv, address = bcast_server
+    ctl = RemoteEngine(address, timeout=30.0)
+    rid = ctl.create_run(BOARD, BOARD)["run_id"]
+    bound = ctl.attach_run(rid)
+    live = bound.subscribe(VIEW_CELLS, timeout=30.0)
+    stalled = None
+    try:
+        live.recv(timeout=30.0)  # live is admitted once frames arrive
+        _hub, gateway = srv._bcast
+        before = set(gateway._subs)
+        stalled = bound.subscribe(VIEW_CELLS, timeout=30.0)
+        deadline = time.monotonic() + 30.0
+        while set(gateway._subs) == before \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        new = set(gateway._subs) - before
+        assert len(new) == 1
+        gs = gateway._subs[next(iter(new))]
+        # Shrink both buffer sides of the stalled path so the gateway
+        # hits EWOULDBLOCK (and the ring overtakes it) fast.
+        stalled._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                 4096)
+        gs.sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        d0 = obs.BCAST_FRAMES_DROPPED.value
+        t0 = live.recv(timeout=30.0)[1]
+        # Stall until the stream head has overtaken the blocked
+        # socket's send cursor by several ring lengths — the gateway's
+        # own state, not a wall-clock guess — while the live viewer
+        # keeps receiving (it must never be held back by the stall).
+        deadline = time.monotonic() + 120.0
+        t1 = t0
+        while time.monotonic() < deadline:
+            t1 = live.recv(timeout=30.0)[1]
+            if gs.stream._seq - gs.next_seq > 24:
+                break
+        assert gs.stream._seq - gs.next_seq > 24
+        assert t1 > t0
+
+        # Drain the stalled subscriber: after the buffered backlog it
+        # must land on a keyframe with the skipped sends metered.
+        last_turn = -1
+        resynced = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            view, turn, _geom, header = stalled.recv(timeout=10.0)
+            drops = obs.BCAST_FRAMES_DROPPED.value - d0
+            if drops > 0 and header["key"] and turn > last_turn:
+                resynced = True
+                break
+            last_turn = max(last_turn, turn)
+        assert resynced
+        assert obs.BCAST_FRAMES_DROPPED.value - d0 > 0
+        # ... and the live subscriber still advances afterwards.
+        assert live.recv(timeout=30.0)[1] >= t1
+    finally:
+        live.close()
+        stalled.close()
+        ctl.destroy_run(rid)
+
+
+def test_subscribe_refused_without_shared_caps(bcast_server):
+    _srv, address = bcast_server
+    ctl = RemoteEngine(address, timeout=30.0)
+    rid = ctl.create_run(BOARD, BOARD)["run_id"]
+    host, port = address.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=10.0)
+    try:
+        wire.send_msg(sock, {"method": "Subscribe", "run_id": rid,
+                             "max_cells": VIEW_CELLS, "caps": []})
+        resp, _ = wire.recv_msg(sock)
+        assert resp["ok"] is False
+        assert "caps" in resp["error"]
+    finally:
+        sock.close()
+        ctl.destroy_run(rid)
+
+
+def test_destroy_run_evicts_every_view_cache_entry(bcast_server):
+    """Regression (satellite): DestroyRun must purge ALL `run_id|vkey`
+    basis entries, not just the destroying client's own."""
+    srv, address = bcast_server
+    c1 = RemoteEngine(address, timeout=30.0)
+    c2 = RemoteEngine(address, timeout=30.0)
+    rid = c1.create_run(BOARD, BOARD)["run_id"]
+    b1 = c1.attach_run(rid)
+    b2 = c2.attach_run(rid)
+    b1.get_view(VIEW_CELLS)
+    b2.get_view(VIEW_CELLS)
+    with srv._view_cache_lock:
+        primed = [k for k in srv._view_cache if k.startswith(f"{rid}|")]
+    assert len(primed) == 2  # two viewers, two basis entries
+    c1.destroy_run(rid)
+    with srv._view_cache_lock:
+        assert not any(k.startswith(f"{rid}|") for k in srv._view_cache)

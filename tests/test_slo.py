@@ -8,6 +8,7 @@ fleet server (the tier-1 face of `make load-smoke`)."""
 
 import math
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -140,8 +141,18 @@ def test_handler_wait_client_split_on_live_server(fleet_server,
     cli = RemoteEngine(f"127.0.0.1:{fleet_server.port}")
     for _ in range(8):
         cli.ping()
-    slo.flush()
-    snap = slo.rpc_snapshot()
+    # The server records its handler/wait sample AFTER sending the
+    # reply, so the 8th sample can still be in flight on the handler
+    # thread when the client returns — give it a bounded moment.
+    deadline = time.monotonic() + 5.0
+    while True:
+        slo.flush()
+        snap = slo.rpc_snapshot()
+        if all(snap[kind].get("Ping", {}).get("count", 0) >= 8
+               for kind in obs_cat.RPC_KINDS) \
+                or time.monotonic() > deadline:
+            break
+        time.sleep(0.02)
     for kind in obs_cat.RPC_KINDS:
         assert snap[kind]["Ping"]["count"] >= 8, \
             f"kind={kind} missed the Ping traffic: {snap.get(kind)}"

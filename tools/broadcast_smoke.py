@@ -1,0 +1,277 @@
+"""broadcast-smoke: end-to-end checks for the broadcast fan-out tier.
+
+One in-process fleet server, one continuously-advancing run, three
+Subscribe viewers — two live decoders and one deliberately stalled
+socket — driving every invariant the tier promises:
+
+  * encode-once: over a measured window, gol_wire_encode_calls_total
+    advances EXACTLY as much as gol_bcast_frames_total — one encode
+    per published frame no matter how many subscribers it fans out to;
+  * shared bytes: both live viewers decode bit-identical boards at
+    every common turn (same wire frames, not per-viewer renders);
+  * slow-subscriber policy: the stalled viewer is skipped ahead to a
+    keyframe (gol_bcast_frames_dropped_total ticks) while the live
+    viewer and the engine's chunk loop never notice;
+  * DestroyRun: every `run_id|vkey` entry leaves the server's view
+    basis cache and subscribers get the end sentinel, not a hang;
+  * gateway sockets carry TCP_NODELAY + SO_KEEPALIVE, and the obs
+    registry exposes the tier's metric families.
+
+Exit 0 = every PASS line printed; nonzero on the first failure class.
+Wired into `make broadcast-smoke` (and the `make smoke` chain) after
+the gated `bench.py --broadcast` leg.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Fast frames + a tiny ring so the stalled viewer falls behind the
+# ring head (and therefore skips) within a second, not a minute.
+os.environ["GOL_BCAST_KEYFRAME"] = "4"
+os.environ["GOL_BCAST_RING"] = "8"
+os.environ["GOL_BCAST_HZ"] = "50"
+
+import numpy as np  # noqa: E402
+
+BOARD = 64
+VIEW_CELLS = BOARD * BOARD
+
+
+def _fail(msg: str) -> int:
+    print(f"broadcast-smoke: FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _bcast_frames(obs) -> float:
+    return sum(ch.value for ch in obs.BCAST_FRAMES.children().values())
+
+
+def main() -> int:
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.fleet import FleetEngine
+    from gol_tpu.obs import REGISTRY
+    from gol_tpu.obs import catalog as obs
+    from gol_tpu.server import EngineServer
+
+    eng = FleetEngine(bucket_sizes=(BOARD,), chunk_turns=2, slot_base=8)
+    srv = EngineServer(port=0, host="127.0.0.1", engine=eng)
+    srv.start_background()
+    address = f"127.0.0.1:{srv.port}"
+    rc = 0
+    live = []          # [(sub, {"turns": {turn: pixels}, "max": int})]
+    stalled = None
+    lock = threading.Lock()
+
+    def _reader(sub, state):
+        # recv() (not frames()) so the end-of-stream ConnectionError —
+        # which carries the server's DestroyRun reason — is observable.
+        try:
+            while True:
+                view, turn, _geom, header = sub.recv(timeout=20.0)
+                with lock:
+                    if len(state["turns"]) < 256:
+                        state["turns"][turn] = view.copy()
+                    state["max"] = max(state["max"], turn)
+                    state["frames"] += 1
+        except Exception as e:  # noqa: BLE001 — checked via state
+            state["error"] = f"{type(e).__name__}: {e}"
+        state["done"] = True
+
+    try:
+        ctl = RemoteEngine(address, timeout=20.0)
+        rid = ctl.create_run(BOARD, BOARD)["run_id"]
+        bound = ctl.attach_run(rid)
+
+        threads = []
+        for _ in range(2):
+            sub = bound.subscribe(VIEW_CELLS, timeout=20.0)
+            state = {"turns": {}, "max": -1, "frames": 0}
+            th = threading.Thread(target=_reader, args=(sub, state),
+                                  daemon=True)
+            th.start()
+            live.append((sub, state))
+            threads.append(th)
+
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            with lock:
+                if all(s["frames"] >= 3 for _, s in live):
+                    break
+            time.sleep(0.05)
+        else:
+            return _fail("live viewers never warmed: "
+                         f"{[dict(s, turns=len(s['turns'])) for _, s in live]}")
+
+        # ---- stalled viewer: subscribe, then never read ----
+        stalled = bound.subscribe(VIEW_CELLS, timeout=20.0)
+        try:  # shrink both buffer sides so the stall bites fast
+            stalled._sock.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_RCVBUF, 4096)
+        except OSError:
+            pass
+        time.sleep(0.3)  # let the gateway admit it
+        hub, gateway = srv._bcast
+        for gsub in list(gateway._subs.values()):
+            try:
+                gsub.sock.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_SNDBUF, 4096)
+            except OSError:
+                pass
+
+        # ---- gateway socket options (satellite: keepalive/nodelay) ----
+        opts_ok = True
+        for gsub in list(gateway._subs.values()):
+            nd = gsub.sock.getsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY)
+            ka = gsub.sock.getsockopt(socket.SOL_SOCKET,
+                                      socket.SO_KEEPALIVE)
+            if not nd or not ka:
+                opts_ok = False
+        if not opts_ok or not gateway._subs:
+            rc |= _fail("adopted sockets missing TCP_NODELAY/"
+                        "SO_KEEPALIVE")
+        else:
+            print(f"broadcast-smoke: PASS — {len(gateway._subs)} "
+                  "adopted socket(s) carry TCP_NODELAY + SO_KEEPALIVE")
+
+        # ---- encode-once window ----
+        e0 = obs.WIRE_ENCODE_CALLS.value
+        f0 = _bcast_frames(obs)
+        d0 = obs.BCAST_FRAMES_DROPPED.value
+        with lock:
+            live_before = live[0][1]["max"]
+        time.sleep(1.5)
+        e1 = obs.WIRE_ENCODE_CALLS.value
+        f1 = _bcast_frames(obs)
+        frames = f1 - f0
+        encodes = e1 - e0
+        if frames <= 0 or encodes != frames:
+            rc |= _fail(f"encode-once broken: {encodes} encode calls "
+                        f"for {frames} published frames")
+        else:
+            print(f"broadcast-smoke: PASS — encode-once: {int(frames)} "
+                  f"frames published, {int(encodes)} encode calls, "
+                  f"3 subscribers")
+        with lock:
+            live_after = live[0][1]["max"]
+        if live_after <= live_before:
+            rc |= _fail("live viewer starved while a subscriber was "
+                        f"stalled (turn {live_before} -> {live_after})")
+        else:
+            print("broadcast-smoke: PASS — live viewer + chunk loop "
+                  f"unaffected by the stall (turn {live_before} -> "
+                  f"{live_after})")
+
+        # ---- drain the stalled viewer: expect a skip to a keyframe ----
+        drops = 0.0
+        resynced = False
+        drain_deadline = time.monotonic() + 15.0
+        last_turn = -1
+        while time.monotonic() < drain_deadline:
+            view, turn, _geom, header = stalled.recv(timeout=5.0)
+            drops = obs.BCAST_FRAMES_DROPPED.value - d0
+            if drops > 0 and header.get("key") and turn > last_turn:
+                resynced = True
+                break
+            last_turn = max(last_turn, turn)
+        if not resynced or drops <= 0:
+            rc |= _fail(f"stalled viewer never resynced: drops={drops} "
+                        f"resynced={resynced}")
+        else:
+            print("broadcast-smoke: PASS — stalled viewer skipped to a "
+                  f"keyframe (turn {turn}), {int(drops)} frame sends "
+                  "dropped and metered")
+
+        # ---- shared-bytes parity between the two live viewers ----
+        with lock:
+            t0 = dict(live[0][1]["turns"])
+            t1 = dict(live[1][1]["turns"])
+        common = sorted(set(t0) & set(t1))
+        if not common:
+            rc |= _fail("live viewers share no common turns")
+        else:
+            bad = [t for t in common
+                   if not np.array_equal(t0[t], t1[t])]
+            if bad:
+                rc |= _fail(f"shared-bytes parity broken at turns {bad[:4]}")
+            else:
+                print("broadcast-smoke: PASS — 2 live viewers decoded "
+                      f"bit-identical boards at {len(common)} common "
+                      "turns")
+
+        # ---- DestroyRun: view-cache purge + stream end sentinel ----
+        bound.get_view(VIEW_CELLS)  # prime the per-viewer basis cache
+        with srv._view_cache_lock:
+            primed = [k for k in srv._view_cache
+                      if k.startswith(f"{rid}|")]
+        if not primed:
+            rc |= _fail("GetView did not prime a run-scoped view-cache "
+                        "entry (smoke assumption broken)")
+        ctl.destroy_run(rid)
+        with srv._view_cache_lock:
+            leaked = [k for k in srv._view_cache
+                      if k.startswith(f"{rid}|")]
+        if leaked:
+            rc |= _fail(f"DestroyRun leaked view-cache entries {leaked}")
+        else:
+            print("broadcast-smoke: PASS — DestroyRun evicted all "
+                  f"{len(primed)} run-scoped view-cache entries")
+        end_deadline = time.monotonic() + 10.0
+        while time.monotonic() < end_deadline:
+            with lock:
+                if all(s.get("done") for _, s in live):
+                    break
+            time.sleep(0.05)
+        ends = [s.get("error", "") for _, s in live]
+        if not all("destroyed" in e for e in ends):
+            rc |= _fail(f"live viewers missed the end sentinel: {ends}")
+        else:
+            print("broadcast-smoke: PASS — both live viewers received "
+                  "the DestroyRun end sentinel")
+
+        # ---- obs registry families ----
+        text = REGISTRY.render_prometheus()
+        missing = [f for f in ("gol_bcast_streams",
+                               "gol_bcast_subscribers",
+                               "gol_gateway_connections",
+                               "gol_bcast_frames_total",
+                               "gol_bcast_frames_dropped_total",
+                               "gol_bcast_sent_bytes_total",
+                               "gol_bcast_fanout_ms")
+                   if f"# TYPE {f} " not in text]
+        if missing:
+            rc |= _fail(f"registry missing families {missing}")
+        else:
+            print("broadcast-smoke: PASS — all 7 broadcast/gateway "
+                  "metric families exposed")
+    except Exception as e:  # noqa: BLE001 — smoke must exit nonzero
+        rc |= _fail(f"unexpected {type(e).__name__}: {e}")
+    finally:
+        for sub, _ in live:
+            try:
+                sub.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if stalled is not None:
+            try:
+                stalled.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        eng.kill_prog()
+        srv.shutdown()
+    if rc == 0:
+        print("broadcast-smoke: OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,14 +7,23 @@ of every call, per method. The numbers come from the caller's own
 clock (time.monotonic around each round trip), so they are END-TO-END:
 connect + request + server queue/accept wait + handler + reply.
 
-Two consumers:
+`--viewers N` adds the broadcast-tier population: N mostly-idle
+Subscribe spectators of one watched run, parked in a `ViewerPool` that
+drains (and discards, without decoding) the pushed epoch-stream bytes
+on a single selectors thread — the C10k shape `bench.py --broadcast`
+scales to 10k+. Viewers and the cycle load can run together: idle
+spectators must not degrade the active control-plane SLOs.
 
-  * `bench.py --load` imports `run_load` to produce the gated
-    `rpc p50/p99 ms (load, <Method>)` metrics against an in-process
-    fleet server (see `make load-smoke`);
+Consumers:
+
+  * `bench.py --load` imports `run_load` for the gated
+    `rpc p50/p99 ms (load, <Method>)` metrics; `bench.py --broadcast`
+    imports `open_viewers`/`ViewerPool` for its spectator population
+    (see `make load-smoke` / `make broadcast-smoke`);
   * standalone, it load-tests ANY reachable server:
 
         python tools/load_smoke.py --address host:8765 --clients 8
+        python tools/load_smoke.py --viewers 2000
 
     With no --address it starts a private in-process fleet server on
     an ephemeral port, which makes the zero-argument invocation a
@@ -30,6 +39,8 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import selectors
+import socket
 import sys
 import threading
 import time
@@ -118,6 +129,166 @@ def run_load(address: str, *, clients: int = 4, cycles: int = 8,
             "cycles": cycles, "wall_s": round(time.monotonic() - t0, 3)}
 
 
+class ViewerPool:
+    """N parked Subscribe spectators on one selectors thread.
+
+    Each added `ViewSubscription`'s socket is drained byte-wise (recv
+    + discard, no decode) so the subscribers look idle to the server —
+    the gateway keeps pushing, the kernel buffers never fill, and the
+    client process spends ~zero CPU per viewer. Byte/EOF counts are
+    the only accounting; frame-level verification belongs to the few
+    fully-decoding tracked viewers the bench runs alongside."""
+
+    def __init__(self) -> None:
+        self._sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, None)
+        self._lock = threading.Lock()
+        self._pending: List = []
+        self._subs: Dict[int, object] = {}
+        self.bytes_received = 0
+        self.closed_count = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="gol-viewer-pool", daemon=True)
+        self._thread.start()
+
+    def add(self, sub) -> None:
+        """Park one ViewSubscription (ownership transfers here)."""
+        with self._lock:
+            self._pending.append(sub)
+        self._poke()
+
+    def alive(self) -> int:
+        with self._lock:
+            return len(self._subs) + len(self._pending)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"alive": len(self._subs) + len(self._pending),
+                    "closed": self.closed_count,
+                    "bytes": self.bytes_received}
+
+    def close(self) -> None:
+        self._stop.set()
+        self._poke()
+        self._thread.join(timeout=5.0)
+
+    def _poke(self) -> None:
+        try:
+            self._wake_w.send(b"\x01")
+        except OSError:
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                events = self._sel.select(timeout=0.5)
+            except OSError:
+                break
+            for key, _ in events:
+                if key.data is None:
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except OSError:
+                        pass
+                    continue
+                self._drain(key.data)
+            with self._lock:
+                pending, self._pending = self._pending, []
+            for sub in pending:
+                try:
+                    sub._sock.setblocking(False)
+                    self._sel.register(
+                        sub._sock, selectors.EVENT_READ, sub)
+                except (OSError, ValueError):
+                    self._dead(sub, registered=False)
+                    continue
+                with self._lock:
+                    self._subs[sub._sock.fileno()] = sub
+        # Teardown: hang every spectator up.
+        for sub in list(self._subs.values()):
+            self._dead(sub)
+        with self._lock:
+            for sub in self._pending:
+                sub.close()
+            self._pending = []
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+
+    def _drain(self, sub) -> None:
+        try:
+            while True:
+                data = sub._sock.recv(1 << 16)
+                if not data:
+                    self._dead(sub)
+                    return
+                with self._lock:
+                    self.bytes_received += len(data)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._dead(sub)
+
+    def _dead(self, sub, registered: bool = True) -> None:
+        if registered:
+            try:
+                self._sel.unregister(sub._sock)
+            except (KeyError, ValueError, OSError):
+                pass
+        with self._lock:
+            self._subs.pop(sub._sock.fileno(), None)
+            self.closed_count += 1
+        sub.close()
+
+
+def open_viewers(address: str, *, viewers: int, run_id: Optional[str],
+                 view_cells: int = 4096, timeout: float = 30.0,
+                 threads: int = 8):
+    """Open `viewers` Subscribe upgrades bound to `run_id` and park
+    them in a ViewerPool. Returns (pool, errors) — errors is the list
+    of subscribe failures (each opener thread stops at its first)."""
+    from gol_tpu.client import RemoteEngine
+
+    pool = ViewerPool()
+    errors: List[str] = []
+    lock = threading.Lock()
+    counter = [0]
+
+    def opener() -> None:
+        eng = RemoteEngine(address, timeout=timeout, run_id=run_id)
+        while True:
+            with lock:
+                if counter[0] >= viewers or errors:
+                    return
+                counter[0] += 1
+            try:
+                pool.add(eng.subscribe(view_cells, timeout=timeout))
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                with lock:
+                    errors.append(f"subscribe: {type(e).__name__}: {e}")
+                return
+
+    workers = [threading.Thread(target=opener, daemon=True,
+                                name=f"gol-viewer-open-{i}")
+               for i in range(max(1, min(threads, viewers)))]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join(timeout=timeout * 4)
+    return pool, errors
+
+
 def summarize(samples: Dict[str, List[float]]) -> Dict[str, dict]:
     """{method: {count, p50_ms, p99_ms, max_ms}} via exact percentiles
     (small populations — no need for the streaming estimator here)."""
@@ -149,6 +320,14 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--board", type=int, default=64,
                     help="square board side per run (default 64)")
     ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--viewers", type=int, default=0,
+                    help="also park N mostly-idle broadcast subscribers "
+                         "on one watched run for --hold seconds "
+                         "(default 0 = none)")
+    ap.add_argument("--view-cells", type=int, default=4096,
+                    help="max_cells of the viewers' subscribed view")
+    ap.add_argument("--hold", type=float, default=2.0,
+                    help="seconds to hold the viewer population open")
     args = ap.parse_args(argv)
 
     server = engine = None
@@ -162,20 +341,52 @@ def main(argv: Optional[list] = None) -> int:
         server = EngineServer(port=0, host="127.0.0.1", engine=engine)
         server.start_background()
         address = f"127.0.0.1:{server.port}"
+    pool = None
+    ctl = watched = None
+    viewer_errors: List[str] = []
+    viewer_stats: Optional[dict] = None
     try:
+        if args.viewers > 0:
+            # Park the mostly-idle spectator population on one watched
+            # run BEFORE the cycle load starts, so the active
+            # control-plane latencies below are measured with the
+            # broadcast tier live.
+            from gol_tpu.client import RemoteEngine
+
+            ctl = RemoteEngine(address, timeout=args.timeout)
+            watched = ctl.create_run(args.board, args.board)["run_id"]
+            pool, viewer_errors = open_viewers(
+                address, viewers=args.viewers, run_id=watched,
+                view_cells=args.view_cells, timeout=args.timeout)
         result = run_load(address, clients=args.clients,
                           cycles=args.cycles, board=args.board,
                           timeout=args.timeout)
+        if pool is not None and not viewer_errors:
+            time.sleep(max(0.0, args.hold))
+            viewer_stats = pool.stats()
     finally:
+        if pool is not None:
+            pool.close()
+        if ctl is not None and watched is not None:
+            try:
+                ctl.destroy_run(watched)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         if engine is not None:
             engine.kill_prog()
         if server is not None:
             server.shutdown()
     table = summarize(result["samples"])
-    print(json.dumps({"address": address, "wall_s": result["wall_s"],
-                      "clients": result["clients"],
-                      "cycles": result["cycles"], "methods": table,
-                      "errors": result["errors"]}, sort_keys=True))
+    summary = {"address": address, "wall_s": result["wall_s"],
+               "clients": result["clients"],
+               "cycles": result["cycles"], "methods": table,
+               "errors": result["errors"]}
+    if args.viewers > 0:
+        summary["viewers"] = {"requested": args.viewers,
+                              "hold_s": args.hold,
+                              "stats": viewer_stats,
+                              "errors": viewer_errors}
+    print(json.dumps(summary, sort_keys=True))
     if result["errors"]:
         for e in result["errors"]:
             print(f"load-smoke: FAIL: {e}", file=sys.stderr)
@@ -185,6 +396,24 @@ def main(argv: Optional[list] = None) -> int:
         print(f"load-smoke: FAIL: no samples for {missing}",
               file=sys.stderr)
         return 1
+    if args.viewers > 0:
+        for e in viewer_errors:
+            print(f"load-smoke: FAIL: viewer: {e}", file=sys.stderr)
+        if viewer_errors:
+            return 1
+        assert viewer_stats is not None
+        if viewer_stats["closed"] or \
+                viewer_stats["alive"] != args.viewers:
+            print(f"load-smoke: FAIL: viewers dropped: {viewer_stats}",
+                  file=sys.stderr)
+            return 1
+        if viewer_stats["bytes"] <= 0:
+            print("load-smoke: FAIL: viewers received zero pushed "
+                  "bytes", file=sys.stderr)
+            return 1
+        print(f"load-smoke: viewers OK — {args.viewers} subscriber(s) "
+              f"held {args.hold}s, {viewer_stats['bytes']} pushed "
+              "bytes drained")
     print(f"load-smoke: OK — {args.clients} client(s) x "
           f"{args.cycles} cycle(s) in {result['wall_s']}s")
     return 0

@@ -65,7 +65,8 @@ DEFAULT_GATE_PATTERN = (
     r"|rpc p\d+ ms|efficiency_pct|fleet_scaling_efficiency_pct"
     r"|overlap_pct|availability_pct|retries_per_call"
     r"|downtime_p\d+_ms|router_overhead_p\d+_ms"
-    r"|halo (?:bytes|exchanges)/turn")
+    r"|halo (?:bytes|exchanges)/turn"
+    r"|encode_calls_per_published_frame|viewer_fanout_p\d+_ms")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
@@ -166,6 +167,13 @@ def _higher_is_better(metric: str, unit: Optional[str]) -> bool:
     if "availability" in low0:
         return True
     if "retries" in low0:
+        return False
+    # Broadcast-tier zero-work witness: encodes per published frame is
+    # a flat COST gate (exactly 1.0 when the fan-out tier shares one
+    # encode across every subscriber) — its unit "calls/frame" hits no
+    # heuristic below and would default to higher-is-better, rewarding
+    # the per-viewer re-encode the gate exists to forbid.
+    if "encode_calls" in low0:
         return False
     # Temporal-fusion halo observables (the --fuse mesh legs): both are
     # per-advanced-turn COSTS — exchanges/turn is the latency-exposure
