@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke fuse-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -127,6 +127,24 @@ federation-smoke:
 	python tools/perf_compare.py BASELINE.json out/federation_smoke.jsonl
 	JAX_PLATFORMS=cpu python tools/federation_smoke.py
 
+# Live-migration check, CPU-only: bench.py --migrate runs 3 real
+# --fleet --federate members behind an in-process router, live-
+# migrates runs between members under routed read traffic (one
+# migrate_fail rollback sub-leg, one kill_member@migrating SIGKILL
+# sub-leg — every injected failure must end rolled back with exactly
+# one authoritative copy), and must stay bit-identical to an
+# unmigrated control fleet AND the device torus replay; the
+# migration_downtime_p99_ms ceiling and availability_pct floor gate
+# via BASELINE.json. tools/migrate_smoke.py then proves the Rescale
+# cutover, the retryable "moved:" straggler answer, and rollback
+# re-migratability end to end.
+migrate-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --migrate \
+		| tee out/migrate_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/migrate_smoke.jsonl
+	JAX_PLATFORMS=cpu python tools/migrate_smoke.py
+
 # Temporal-fusion check, CPU-only: a reduced bench.py --fuse matrix
 # (k ∈ {1,4}, 512² dense + one 2-way mesh leg) run in-process, every
 # leg parity-gated bit-identical vs the k=1 torus replay, the analytic
@@ -138,7 +156,7 @@ fuse-smoke:
 	JAX_PLATFORMS=cpu python tools/fuse_smoke.py
 
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke fuse-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -2382,6 +2382,396 @@ def bench_federation(members: int = FED_MEMBERS, runs: int = FED_RUNS,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+MIG_MEMBERS = 3           # two clean members + one migrate_fail-armed
+MIG_RUNS = 8              # initial seeds; topped up until HRW covers
+MIG_BOARD = 64
+MIG_TARGET = 24
+MIG_WARM_WINDOW_S = 1.5
+
+
+def bench_migrate(n: int = MIG_BOARD, target: int = MIG_TARGET) -> int:
+    """Live-migration leg (PR 15): three real `--fleet --federate`
+    member processes behind an in-process FederationRouter; seeded
+    boards are HRW-placed through the router and parked at a target
+    turn, then live-migrated BETWEEN members with `Rescale` while a
+    routed-read sampler hammers every run. Emits two GATED lines:
+    migration_downtime_p99_ms (ceiling — per-migration client-visible
+    stall, the longest gap between successive successful routed reads
+    of the migrating run; downtime is LATENCY, never an error) and
+    availability_pct (floor — every routed protected call across the
+    whole leg, migrations and chaos included). Hard-fails
+    independently of the perf gate when: a post-migration board
+    diverges from an unmigrated in-process control fleet of the same
+    seeds or from the device torus replay oracle; the migrate_fail
+    chaos member's first Rescale does NOT roll back (or rolls back
+    without leaving the run intact, routable, and re-migratable on
+    the source); or the kill_member@migrating leg (source member
+    SIGKILLed mid-Rescale) ends with zero or two listed copies of the
+    victim run — exactly one member may answer for it."""
+    import os
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import federation_smoke as fed
+
+    from gol_tpu import chaos
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.federation.router import FederationRouter
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.obs import slo as obs_slo
+
+    for var in ("GOL_CHAOS", "GOL_RPC_RETRIES", "GOL_RULE",
+                "GOL_CKPT", "GOL_CKPT_EVERY_TURNS",
+                "GOL_MIGRATE_DEADLINE", "GOL_MIGRATE_STALE"):
+        os.environ.pop(var, None)
+    os.environ.update(fed.FED_ENV)
+    # Generous coordinator budget: a cold CPU host may compile the
+    # target's bucket program inside the resume phase.
+    mig_env = {"GOL_MIGRATE_DEADLINE": "120"}
+    tmpdir = tempfile.mkdtemp(prefix="gol_mig_bench_")
+    ckpt_root = os.path.join(tmpdir, "ck")
+    router = FederationRouter(port=0).start_background()
+    # The LAST member spawns with a one-shot migrate_fail armed in its
+    # own environment: the first Rescale IT coordinates (it is the
+    # source; the coordinator runs in the source process) must fail at
+    # the transfer boundary and roll back.
+    procs = [fed.spawn_member(tmpdir, ckpt_root, router.port,
+                              ckpt_every=4, extra_env=mig_env)
+             for _ in range(MIG_MEMBERS - 1)]
+    procs.append(fed.spawn_member(
+        tmpdir, ckpt_root, router.port, ckpt_every=4,
+        extra_env={**mig_env, "GOL_CHAOS": "migrate_fail=transfer"}))
+    samples = []            # (ok, wall_s) per routed protected call
+    stalls_ms = []          # per-migration client-visible stall
+    rc = 0
+    try:
+        addrs = []
+        for p in procs:
+            addr = fed.wait_member(p)
+            if addr is None:
+                print("BENCH LEG FAILED (migrate): a member never "
+                      "announced its port", file=sys.stderr)
+                return 1
+            addrs.append(addr)
+        chaos_addr = addrs[-1]
+        clean_addrs = addrs[:-1]
+        if not fed.wait_live(router, MIG_MEMBERS):
+            print("BENCH LEG FAILED (migrate): registry never saw "
+                  f"{MIG_MEMBERS} live members", file=sys.stderr)
+            return 1
+        cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=60.0)
+        rng = np.random.default_rng(37)
+        seeds = {}
+
+        def create_batch(count):
+            for _ in range(count):
+                rid = f"m{len(seeds)}"
+                seeds[rid] = (rng.random((n, n)) < 0.3).astype(
+                    np.uint8)
+                cli.create_run(n, n, board=seeds[rid], run_id=rid,
+                               ckpt_every=4, target_turn=target)
+
+        # HRW placement is the router's choice; top up the run
+        # population until the chaos member owns at least one run and
+        # the clean members own at least two between them.
+        create_batch(MIG_RUNS)
+        owners = None
+        for _ in range(6):
+            owners = fed.wait_runs_at(cli, sorted(seeds), target)
+            if owners is None:
+                print("BENCH LEG FAILED (migrate): runs never parked "
+                      "at their target turn", file=sys.stderr)
+                return 1
+            by_owner = {a: sorted(r for r, m in owners.items()
+                                  if m == a) for a in addrs}
+            if by_owner[chaos_addr] and sum(
+                    len(by_owner[a]) for a in clean_addrs) >= 2:
+                break
+            create_batch(3)
+        else:
+            print("BENCH LEG FAILED (migrate): HRW never placed a "
+                  "run on every member needed by the scenario",
+                  file=sys.stderr)
+            return 1
+        ids = sorted(seeds)
+        bound = {rid: cli.for_run(rid) for rid in ids}
+
+        def protected_call(rid) -> bool:
+            t0 = time.perf_counter()
+            try:
+                bound[rid].stats()
+                ok = True
+            except Exception:
+                ok = False
+            samples.append((ok, time.perf_counter() - t0))
+            return ok
+
+        # Steady-state window: migration-free availability samples.
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < MIG_WARM_WINDOW_S:
+            for rid in ids:
+                protected_call(rid)
+
+        def migrate_once(rid, dst, expect_rollback=False):
+            """One Rescale with a dedicated reader hammering the
+            migrating run; returns the coordinator's record (or the
+            rollback error). The client-visible stall — the longest
+            gap between successive successful reads, window edges
+            included — lands in stalls_ms for successful cutovers."""
+            out = {}
+            done = threading.Event()
+
+            def call():
+                try:
+                    out["result"] = cli.rescale(rid, dst)
+                except Exception as e:
+                    out["error"] = e
+                finally:
+                    done.set()
+
+            th = threading.Thread(target=call, daemon=True)
+            last = time.perf_counter()
+            max_gap = 0.0
+            th.start()
+            while not done.is_set():
+                if protected_call(rid):
+                    now = time.perf_counter()
+                    max_gap = max(max_gap, now - last)
+                    last = now
+            th.join()
+            # Close the window on a post-cutover success: a redirect
+            # that leaves the run unreadable must show up as stall.
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if protected_call(rid):
+                    break
+                time.sleep(0.02)
+            max_gap = max(max_gap, time.perf_counter() - last)
+            if "error" not in out:
+                stalls_ms.append(max_gap * 1e3)
+            return out
+
+        # Chaos sub-leg 1: the armed member's FIRST Rescale must fail
+        # at the transfer boundary and roll back — run intact on the
+        # source, still routable, still parked at its turn.
+        chaos_rid = by_owner[chaos_addr][0]
+        dst0 = clean_addrs[0]
+        out = migrate_once(chaos_rid, dst0, expect_rollback=True)
+        err = out.get("error")
+        if err is None or "rolled back" not in str(err):
+            print("BENCH LEG FAILED (migrate): the migrate_fail "
+                  "member's first Rescale did not roll back "
+                  f"(got {out})", file=sys.stderr)
+            return 1
+        runs, _ = cli.list_runs()
+        rec = {r["run_id"]: r for r in runs}.get(chaos_rid)
+        if (rec is None or rec["member"] != chaos_addr
+                or rec["turn"] != target):
+            print("BENCH LEG FAILED (migrate): rollback did not "
+                  f"leave {chaos_rid} intact on its source "
+                  f"(rec={rec})", file=sys.stderr)
+            return 1
+        # The one-shot is spent: the SAME run must now migrate clean —
+        # rollback left it fully re-migratable.
+        out = migrate_once(chaos_rid, dst0)
+        if "error" in out or out["result"]["status"] != "ok":
+            print("BENCH LEG FAILED (migrate): post-rollback Rescale "
+                  f"of {chaos_rid} failed ({out})", file=sys.stderr)
+            return 1
+        coord_downtimes = [out["result"]["downtime_ms"]]
+
+        # Clean cutovers: ping-pong every clean-owned run between the
+        # two clean members (each run migrates away and back).
+        mig_runs = [r for a in clean_addrs for r in by_owner[a]][:4]
+        for rid in mig_runs:
+            src = owners[rid]
+            dst = [a for a in clean_addrs if a != src][0]
+            for hop in (dst, src):
+                out = migrate_once(rid, hop)
+                if "error" in out or out["result"]["status"] != "ok":
+                    print("BENCH LEG FAILED (migrate): Rescale of "
+                          f"{rid} to {hop} failed ({out})",
+                          file=sys.stderr)
+                    return 1
+                coord_downtimes.append(out["result"]["downtime_ms"])
+
+        # Chaos sub-leg 2: SIGKILL the source member mid-Rescale. The
+        # harness owns the subprocess; chaos decides the instant (the
+        # @migrating spec fires only while a migration is in flight).
+        victim_rid = mig_runs[0]
+        src = owners[victim_rid]         # back home after the pingpong
+        src_i = addrs.index(src)
+        dst = [a for a in clean_addrs if a != src][0]
+        injected0 = sum(c.value for c in
+                        obs_cat.CHAOS_INJECTED.children().values())
+        os.environ["GOL_CHAOS"] = f"kill_member={src}@migrating"
+        killed = False
+        kill_out = {}
+        kill_done = threading.Event()
+
+        def kill_call():
+            try:
+                kill_out["result"] = cli.rescale(victim_rid, dst)
+            except Exception as e:
+                kill_out["error"] = e
+            finally:
+                kill_done.set()
+
+        try:
+            th = threading.Thread(target=kill_call, daemon=True)
+            t_arm = time.perf_counter()
+            th.start()
+            while not killed:
+                elapsed = time.perf_counter() - t_arm
+                if chaos.take_kill_member(src, src_i, elapsed,
+                                          migrating=not
+                                          kill_done.is_set()):
+                    os.kill(procs[src_i].pid, signal.SIGKILL)
+                    procs[src_i].wait(10)
+                    killed = True
+                elif kill_done.is_set():
+                    break
+                else:
+                    for rid in ids:
+                        if rid != victim_rid:
+                            protected_call(rid)
+            th.join(timeout=150.0)
+        finally:
+            os.environ.pop("GOL_CHAOS", None)
+        injected = sum(c.value for c in
+                       obs_cat.CHAOS_INJECTED.children().values()
+                       ) - injected0
+        if not killed or injected < 1:
+            print("BENCH LEG FAILED (migrate): kill_member@migrating "
+                  "never fired — the mid-migration death would be "
+                  "vacuous", file=sys.stderr)
+            return 1
+        # Exactly one live authoritative copy: the federation must
+        # re-home the victim run (staged-copy promotion or checkpoint
+        # adoption — either is legitimate) and every run must answer
+        # through the SAME router address at the SAME target turn.
+        post = fed.wait_runs_at(cli, ids, target, timeout=240.0)
+        if post is None:
+            try:
+                now_runs, _ = cli.list_runs()
+            except Exception as e:
+                now_runs = [{"list_runs_error": str(e)}]
+            print("BENCH LEG FAILED (migrate): runs never re-parked "
+                  f"after the mid-migration SIGKILL — now: {now_runs}",
+                  file=sys.stderr)
+            return 1
+        survivors = [a for a in addrs if a != src]
+        listed_at = []
+        for a in survivors:
+            try:
+                mruns, _ = RemoteEngine(a, timeout=30.0).list_runs()
+            except Exception as e:
+                print("BENCH LEG FAILED (migrate): survivor "
+                      f"{a} unreachable after the kill ({e})",
+                      file=sys.stderr)
+                return 1
+            listed_at.extend(a for r in mruns
+                             if r["run_id"] == victim_rid)
+        if len(listed_at) != 1:
+            print("BENCH LEG FAILED (migrate): expected exactly one "
+                  f"authoritative copy of {victim_rid}, found "
+                  f"{len(listed_at)} ({listed_at})", file=sys.stderr)
+            return 1
+
+        # Parity: every run through the router vs an unmigrated
+        # in-process control fleet of the same seeds, and vs the
+        # device torus replay oracle.
+        os.environ["GOL_CKPT"] = os.path.join(tmpdir, "ck_control")
+        from gol_tpu.fleet import FleetEngine
+
+        ctrl = FleetEngine(bucket_sizes=(n,), chunk_turns=4,
+                           slot_base=max(4, len(ids)))
+        try:
+            for rid in ids:
+                ctrl.create_run(n, n, board=seeds[rid].copy(),
+                                run_id=rid, target_turn=target)
+            for rid in ids:
+                if not ctrl._runs[rid].done.wait(120):
+                    print("BENCH LEG FAILED (migrate): control run "
+                          f"{rid} never finished", file=sys.stderr)
+                    return 1
+                cb, ct = ctrl._run_board(ctrl._runs[rid])
+                fb, ft = bound[rid].get_world()
+                ok_ctrl = ct == ft == target and np.array_equal(
+                    (fb != 0), (cb != 0))
+                ok_oracle = np.array_equal(
+                    (fb != 0).astype(np.uint8),
+                    fed.expected_board01(seeds[rid], target))
+                if not (ok_ctrl and ok_oracle):
+                    try:
+                        now_runs, _ = cli.list_runs()
+                        now_rec = {r["run_id"]: r
+                                   for r in now_runs}.get(rid)
+                    except Exception as e:
+                        now_rec = f"list_runs failed: {e}"
+                    print(f"PARITY FAIL (migrate): {rid} vs "
+                          f"control={ok_ctrl} (turns {ft}/{ct}), vs "
+                          f"oracle={ok_oracle} — rec={now_rec} "
+                          f"placement={router._placements.get(rid)}",
+                          file=sys.stderr)
+                    rc |= 1
+        finally:
+            ctrl.kill_prog()
+            os.environ.pop("GOL_CKPT", None)
+
+        calls = len(samples)
+        failures = sum(1 for ok, _ in samples if not ok)
+        availability = 100.0 * (calls - failures) / max(calls, 1)
+        stall_p99 = obs_slo.exact_percentiles(
+            [v / 1e3 for v in sorted(stalls_ms)], (0.99,))[0] * 1e3
+        detail = {
+            "members": MIG_MEMBERS, "runs": len(ids), "size": n,
+            "target_turn": target,
+            "migrations": len(stalls_ms),
+            "stall_ms_per_migration": [round(v, 1)
+                                       for v in stalls_ms],
+            "coordinator_downtime_ms": coord_downtimes,
+            "rollback_leg": {"run": chaos_rid,
+                             "armed": "migrate_fail=transfer",
+                             "remigrated_clean": True},
+            "kill_leg": {"run": victim_rid, "victim_member": src,
+                         "rehomed_to": post[victim_rid],
+                         "listed_copies": len(listed_at)},
+            "routed_calls": calls, "failures": failures,
+            "fed_env": dict(fed.FED_ENV),
+            "chaos_injected": int(injected),
+            "parity_check": "every post-migration board vs an "
+                            "unmigrated in-process control fleet of "
+                            "the same seeds AND vs the device torus "
+                            "replay, bit-identical at the target "
+                            "turn",
+            "method": "stall = longest gap between successive "
+                      "successful routed reads of the migrating run, "
+                      "window edges included (client-visible "
+                      "downtime; a quiesced run keeps serving its "
+                      "frozen board, stragglers get a retryable "
+                      "moved: answer, so downtime is latency, never "
+                      "an error); coordinator_downtime_ms is the "
+                      "resume+redirect slice the server meters",
+        }
+        _emit("migration_downtime_p99_ms (migrate, live cutover)",
+              round(stall_p99, 1), "ms", None, detail)
+        _emit("availability_pct (migrate, routed traffic)",
+              round(availability, 3), "%", None, detail)
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(10)
+        router.shutdown()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=None,
@@ -2482,6 +2872,16 @@ def main() -> int:
                          "(emits the gated availability_pct / "
                          "failover_downtime_p99_ms / "
                          "router_overhead_p99_ms lines)")
+    ap.add_argument("--migrate", action="store_true",
+                    help="run the live-migration leg only: 3 --fleet "
+                         "--federate member processes behind an "
+                         "in-process router, runs live-migrated "
+                         "between members with Rescale under routed "
+                         "read traffic, one migrate_fail rollback "
+                         "sub-leg and one kill_member@migrating "
+                         "SIGKILL sub-leg (emits the gated "
+                         "migration_downtime_p99_ms / "
+                         "availability_pct lines)")
     ap.add_argument("--mesh", action="store_true",
                     help="run the multi-device scaling legs only: "
                          "strong (fixed 1024²) and weak (256 rows/dev) "
@@ -2604,11 +3004,22 @@ def _dispatch(args, ap) -> int:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
-                or args.mesh or args.size is not None \
+                or args.mesh or args.migrate \
+                or args.size is not None \
                 or args.turns is not None:
             ap.error("--federation is its own config; it takes no "
                      "other leg flags")
         return bench_federation()
+
+    if args.migrate:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.chaos or args.fleet or args.load \
+                or args.mesh or args.size is not None \
+                or args.turns is not None:
+            ap.error("--migrate is its own config; it takes no "
+                     "other leg flags")
+        return bench_migrate()
 
     if args.fuse:
         if args.pattern != "dense" or args.gen or args.engine \

@@ -27,14 +27,23 @@ given seed yields the same fault sequence on every run):
                   ConnectionRefusedError before the socket ever
                   connects (fires from `dial_hook`, not the send/recv
                   hooks — exercising the dial-retry attribution path).
-- ``kill_member=<addr|idx>[@s]``
+- ``kill_member=<addr|idx>[@s|@migrating]``
                   arm the federation process-kill hook:
                   `take_kill_member(addr, idx, elapsed_s)` fires exactly
                   once per process when the harness polling it reports
                   elapsed seconds >= s (omitted s draws a seeded time in
                   [0.5, 1.5) s) for the member whose address or index
-                  matches. Chaos decides WHICH member and WHEN; the
+                  matches. ``@migrating`` defers the trigger until the
+                  harness reports a Rescale migration in flight on that
+                  member (`migrating=True`), killing the coordinator
+                  mid-cutover. Chaos decides WHICH member and WHEN; the
                   harness owning the subprocess delivers the SIGKILL.
+- ``migrate_fail=<phase>``
+                  arm the migration-phase fault: `take_migrate_fail(p)`
+                  fires exactly once per process when the Rescale
+                  coordinator enters the named phase (quiesce /
+                  checkpoint / transfer / resume / redirect), forcing
+                  that phase to fail so the rollback path runs.
 - ``seed=N``      RNG seed (default 0).
 - ``poison=<run_id>[@<turn>]``
                   arm the fleet poison hook: `take_poison(run_id, turn)`
@@ -73,7 +82,7 @@ def _parse(spec: str) -> dict:
         key, _, val = part.partition("=")
         key = key.strip()
         val = val.strip()
-        if key in ("poison", "kill_member"):
+        if key in ("poison", "kill_member", "migrate_fail"):
             cfg[key] = val
         elif key == "seed":
             try:
@@ -109,11 +118,16 @@ class ChaosInjector:
         self._kill_target: Optional[str] = None
         self._kill_at_s = 0.0
         self._kill_fired = False
+        self._kill_on_migrating = False
         km = cfg.get("kill_member")
         if km:
             target, _, at = str(km).partition("@")
             self._kill_target = target.strip()
-            if at:
+            if at == "migrating":
+                # Fire while a Rescale cutover is in flight, whenever
+                # that happens — the harness reports the condition.
+                self._kill_on_migrating = True
+            elif at:
                 try:
                     self._kill_at_s = float(at)
                 except ValueError:
@@ -121,6 +135,12 @@ class ChaosInjector:
             else:
                 # Seeded default: same spec, same kill time, every run.
                 self._kill_at_s = 0.5 + self._rng.random()
+        # migrate_fail=<phase> — one-shot forced Rescale phase failure.
+        self._migrate_phase: Optional[str] = None
+        self._migrate_fired = False
+        mf = cfg.get("migrate_fail")
+        if mf:
+            self._migrate_phase = str(mf).strip()
         # poison=<run_id>[@<turn>] — one-shot fleet popcount poison.
         self._poison_run: Optional[str] = None
         self._poison_turn = 0
@@ -217,13 +237,18 @@ class ChaosInjector:
             _INJECTED["refuse"].inc()
             raise ConnectionRefusedError(f"chaos: refused dial to {addr}")
 
-    def take_kill_member(self, addr: str, idx: int,
-                         elapsed_s: float) -> bool:
+    def take_kill_member(self, addr: str, idx: int, elapsed_s: float,
+                         migrating: bool = False) -> bool:
         """True exactly once, when the armed member (by address or
-        index) is polled at/after the armed elapsed time."""
+        index) is polled at/after the armed elapsed time — or, for an
+        `@migrating` spec, while the harness reports a migration in
+        flight on it."""
         if self._kill_target is None or self._kill_fired:
             return False
-        if elapsed_s < self._kill_at_s:
+        if self._kill_on_migrating:
+            if not migrating:
+                return False
+        elif elapsed_s < self._kill_at_s:
             return False
         if self._kill_target not in (addr, str(idx)):
             return False
@@ -232,6 +257,20 @@ class ChaosInjector:
                 return False
             self._kill_fired = True
         _INJECTED["kill_member"].inc()
+        return True
+
+    def take_migrate_fail(self, phase: str) -> bool:
+        """True exactly once, when the Rescale coordinator enters the
+        armed phase name."""
+        if self._migrate_phase is None or self._migrate_fired:
+            return False
+        if phase != self._migrate_phase:
+            return False
+        with self._lock:
+            if self._migrate_fired:
+                return False
+            self._migrate_fired = True
+        _INJECTED["migrate_fail"].inc()
         return True
 
     def take_poison(self, run_id: str, turn: int) -> bool:
@@ -300,8 +339,15 @@ def dial_hook(addr) -> None:
         inj.on_dial(addr)
 
 
-def take_kill_member(addr: str, idx: int, elapsed_s: float) -> bool:
+def take_kill_member(addr: str, idx: int, elapsed_s: float,
+                     migrating: bool = False) -> bool:
     inj = injector()
     if inj is None:
         return False
-    return inj.take_kill_member(addr, idx, elapsed_s)
+    return inj.take_kill_member(addr, idx, elapsed_s,
+                                migrating=migrating)
+
+
+def take_migrate_fail(phase: str) -> bool:
+    inj = injector()
+    return False if inj is None else inj.take_migrate_fail(phase)

@@ -23,6 +23,9 @@ Modules:
     writer.py     background double-buffered writer (off the hot loop)
     retention.py  keep-last + keep-every-K-turns GC, crash-safe
     restore.py    resolve dir|manifest|legacy-npz -> verified engine state
+    reshard.py    geometry contract + host-side canonical repack, so a
+                  checkpoint resumes onto a different mesh shape,
+                  representation family, or engine kind (bit-identical)
 
 Env / flags (read at run time, like every GOL_* knob):
 
@@ -42,6 +45,12 @@ from gol_tpu.ckpt.manifest import (  # noqa: F401
     read_manifest,
     verify_manifest,
     write_manifest,
+)
+from gol_tpu.ckpt.reshard import (  # noqa: F401
+    GeometryMismatch,
+    load_canonical,
+    reshard_into,
+    restore_delta,
 )
 from gol_tpu.ckpt.restore import resolve, restore_engine  # noqa: F401
 from gol_tpu.ckpt.retention import RetentionPolicy  # noqa: F401

@@ -1,0 +1,243 @@
+"""Reshard-at-restore: resume any checkpoint onto any geometry.
+
+Manifests have recorded placement geometry since PR 9/11 (the mesh
+stamp) and the representation since PR 3 (`repr`), but restore always
+assumed the writing process and the resuming process agreed on both:
+a packed-words payload written on a 4-way mesh only loaded back onto a
+4-way mesh, a sparse window only onto a same-size sparse torus, and a
+fleet-bucket payload only into an identically shaped slot. This module
+makes geometry *mutable* at the restore boundary:
+
+* `restore_delta(manifest, engine)` names every way the checkpoint's
+  recorded geometry disagrees with the engine that wants to load it
+  (mesh device count, representation family, sparse torus size). A
+  non-empty delta without an explicit reshard request is refused with
+  `GeometryMismatch` — tagged `rpc_error_kind="geometry"` so the wire
+  layer surfaces a diagnosable `geometry:` error instead of a shape
+  crash deep in the install path.
+
+* `reshard_into(engine, manifest, payload)` is the host-side repack:
+  decode the payload to a canonical board (exact, bit-identical — no
+  resampling, the board IS the state), then re-encode it in whatever
+  npz dialect the target engine's own `load_checkpoint` verifies and
+  installs. The target engine splits the board across ITS devices on
+  install, which is what makes a 4-way checkpoint resume on 1/2/8-way
+  without a bit of drift: the torus is device-count-invariant, only
+  the halo partitioning changes.
+
+Canonical decode covers every repr the writer emits
+(`ckpt/writer.py::payload_arrays`): packed `words`, raw `world`
+pixels, Generations `gen_planes`/`gen_state`, and the sparse
+window-words form (embedded into its full torus with wraparound).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+from gol_tpu.obs.log import log as obs_log
+
+
+class GeometryMismatch(ValueError):
+    """Checkpoint geometry disagrees with the resuming engine and no
+    reshard was requested. Tagged so server.py can answer with a
+    `geometry:` error the client maps back to a tagged exception."""
+
+    rpc_error_kind = "geometry"
+
+
+class Canonical:
+    """One checkpoint decoded to its exact host-side state.
+
+    kind is "life" ({0,1} board01), "gen" (Generations state bytes) or
+    "pixels" (raw u8 pixels whose interpretation the target engine's
+    rule decides — the legacy `world` member round-trips verbatim)."""
+
+    __slots__ = ("kind", "board", "turn", "rule")
+
+    def __init__(self, kind: str, board: np.ndarray, turn: int,
+                 rule: Optional[str]) -> None:
+        self.kind = kind
+        self.board = board
+        self.turn = int(turn)
+        self.rule = rule
+
+
+def _words_to_board(words: np.ndarray, h: int, w: int) -> np.ndarray:
+    from gol_tpu.ops.bitpack import unpack_np, words_bytes_np
+
+    return unpack_np(words_bytes_np(np.asarray(words)), h, w)
+
+
+def _board_to_words(board01: np.ndarray) -> np.ndarray:
+    from gol_tpu.ops.bitpack import pack_np
+
+    return pack_np(np.ascontiguousarray(board01)).view("<u4")
+
+
+def load_canonical(payload_path: str) -> Canonical:
+    """Decode any writer payload (or legacy autosave npz) to canonical
+    host state. Pure host-side numpy — bit-exact by construction."""
+    with np.load(payload_path) as z:
+        turn = int(z["turn"]) if "turn" in z else 0
+        rule = str(z["rulestring"]) if "rulestring" in z else None
+        if "sparse_words" in z:
+            sw = np.ascontiguousarray(z["sparse_words"], dtype=np.uint32)
+            size = int(z["size"])
+            ox, oy = int(z["ox"]), int(z["oy"])
+            if sw.ndim != 2:
+                raise ValueError("sparse_words must be 2-D")
+            win = _words_to_board(sw, sw.shape[0], sw.shape[1] * 32)
+            board = np.zeros((size, size), dtype=np.uint8)
+            rows = (np.arange(win.shape[0]) + oy) % size
+            cols = (np.arange(win.shape[1]) + ox) % size
+            board[np.ix_(rows, cols)] = win
+            return Canonical("life", board, turn, rule)
+        if "gen_planes" in z:
+            planes = np.asarray(z["gen_planes"], dtype=np.uint32)
+            width = int(z["width"])
+            if planes.ndim != 3 or planes.shape[0] != 2:
+                raise ValueError("gen_planes must be (2, h, words)")
+            h = planes.shape[1]
+            state = (_words_to_board(planes[0], h, width)
+                     + 2 * _words_to_board(planes[1], h, width)
+                     ).astype(np.uint8)
+            return Canonical("gen", state, turn, rule)
+        if "gen_state" in z:
+            state = np.ascontiguousarray(z["gen_state"], dtype=np.uint8)
+            if state.ndim != 2:
+                raise ValueError("gen_state must be 2-D")
+            return Canonical("gen", state, turn, rule)
+        if "words" in z:
+            words = np.ascontiguousarray(z["words"], dtype=np.uint32)
+            width = int(z["width"])
+            if words.ndim != 2 or words.shape[-1] * 32 != width:
+                raise ValueError(
+                    f"words shape {words.shape} inconsistent with "
+                    f"width {width}")
+            board = _words_to_board(words, words.shape[0], width)
+            return Canonical("life", board, turn, rule)
+        if "world" in z:
+            world = np.ascontiguousarray(z["world"], dtype=np.uint8)
+            if world.ndim != 2:
+                raise ValueError("world must be 2-D")
+            return Canonical("pixels", world, turn, rule)
+    raise ValueError(
+        f"{payload_path}: no decodable payload member (expected one of "
+        f"sparse_words / gen_planes / gen_state / words / world)")
+
+
+def board01_of(can: Canonical) -> np.ndarray:
+    """Canonical state as a {0,1} uint8 board (life-like kinds only)."""
+    if can.kind == "life":
+        return can.board
+    if can.kind == "pixels":
+        return (can.board != 0).astype(np.uint8)
+    raise GeometryMismatch(
+        "Generations state has no binary-board form; reshard it onto a "
+        "Generations engine with the same rule family")
+
+
+# -- engine geometry contract ------------------------------------------
+
+def engine_geometry(engine) -> Optional[dict]:
+    """The engine's declared geometry (duck-typed `geometry()`), or
+    None when the engine predates the contract — then nothing is
+    enforced and restore behaves exactly as before this module."""
+    fn = getattr(engine, "geometry", None)
+    if fn is None:
+        return None
+    return fn()
+
+
+def restore_delta(manifest: dict, engine) -> List[str]:
+    """Every way `manifest`'s recorded geometry disagrees with
+    `engine`. Empty list = the direct payload load is already correct.
+    Board height/width are deliberately NOT a delta for dense/fleet
+    engines: their install paths have always adopted the checkpoint's
+    shape, and refusing that now would regress working resumes."""
+    geo = engine_geometry(engine)
+    if geo is None:
+        return []
+    deltas: List[str] = []
+    mrepr = str(manifest.get("repr", ""))
+    kind = geo.get("kind")
+    if kind == "sparse":
+        if mrepr != "sparse":
+            deltas.append(f"repr {mrepr} -> sparse engine")
+        else:
+            extra = manifest.get("sparse") or {}
+            msize = int(extra.get("size",
+                                  manifest.get("board", {}).get("h", 0)))
+            if msize != int(geo.get("size", msize)):
+                deltas.append(f"sparse torus {msize} -> "
+                              f"{geo.get('size')}")
+    elif mrepr == "sparse":
+        deltas.append(f"repr sparse -> {kind} engine")
+    mdev = (manifest.get("mesh") or {}).get("devices")
+    gdev = geo.get("devices")
+    if mdev and gdev and int(mdev) != int(gdev):
+        deltas.append(f"mesh devices {mdev} -> {gdev}")
+    return deltas
+
+
+# -- the repack itself -------------------------------------------------
+
+def write_repacked(can: Canonical, engine, out_path: str) -> None:
+    """Re-encode canonical state into the npz dialect the target
+    engine's `load_checkpoint` accepts, at `out_path` (atomic via the
+    caller's temp-file discipline)."""
+    geo = engine_geometry(engine) or {}
+    kind = geo.get("kind")
+    meta = {"turn": np.int64(can.turn)}
+    if can.rule is not None:
+        meta["rulestring"] = np.str_(can.rule)
+    if kind == "sparse":
+        board = board01_of(can)
+        size = int(geo.get("size", 0))
+        if board.shape != (size, size):
+            raise GeometryMismatch(
+                f"board {board.shape[0]}x{board.shape[1]} cannot "
+                f"reshard onto a {size}-torus sparse engine (the torus "
+                f"size is fixed at construction)")
+        np.savez(out_path, sparse_words=_board_to_words(board),
+                 ox=np.int64(0), oy=np.int64(0), size=np.int64(size),
+                 **meta)
+        return
+    if can.kind == "gen":
+        np.savez(out_path, gen_state=can.board, **meta)
+        return
+    if can.kind == "pixels":
+        np.savez(out_path, world=can.board, **meta)
+        return
+    # Life board01 -> legacy world pixels: the one dialect every dense
+    # and fleet install path accepts at any width; the engine re-packs
+    # to words itself when its representation choice says so.
+    np.savez(out_path, world=(can.board * np.uint8(255)), **meta)
+
+
+def reshard_into(engine, manifest: Optional[dict],
+                 payload_path: str) -> int:
+    """Decode `payload_path`, repack for `engine`, install through the
+    engine's own verified `load_checkpoint`. Returns the restored turn
+    (always the checkpoint's turn — resharding never advances time)."""
+    can = load_canonical(payload_path)
+    fd, tmp = tempfile.mkstemp(suffix=".npz", prefix="gol-reshard-")
+    os.close(fd)
+    try:
+        write_repacked(can, engine, tmp)
+        turn = engine.load_checkpoint(tmp)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    geo = engine_geometry(engine) or {}
+    obs_log("ckpt.resharded", kind=can.kind, turn=turn,
+            devices=geo.get("devices"), engine=geo.get("kind"),
+            payload=os.path.basename(payload_path))
+    return turn

@@ -23,6 +23,7 @@ import os
 from typing import Optional, Tuple
 
 from gol_tpu.ckpt import manifest as mf
+from gol_tpu.ckpt import reshard as _reshard
 from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import trace as obs_trace
 from gol_tpu.obs.log import log as obs_log
@@ -46,10 +47,18 @@ def resolve(path: str) -> Tuple[str, Optional[str]]:
     return "legacy", path
 
 
-def restore_engine(engine, path: str, verify: bool = True) -> int:
+def restore_engine(engine, path: str, verify: bool = True,
+                   reshard: bool = False) -> int:
     """Verify + install a checkpoint into `engine`; returns the restored
     turn. `engine` is anything with the `load_checkpoint(npz_path)`
-    surface (dense Engine or SparseEngine)."""
+    surface (dense Engine or SparseEngine).
+
+    When the manifest records a geometry the engine disagrees with
+    (mesh device count, representation family, sparse torus size — see
+    ckpt/reshard.py), the restore is refused with `GeometryMismatch`
+    (tagged rpc_error_kind="geometry") unless `reshard=True`, which
+    routes the payload through the host-side canonical repack instead
+    of the direct load — bit-identical, only the placement changes."""
     kind, target = resolve(path)
     with obs_trace.span("ckpt.restore",
                         attrs={"kind": kind,
@@ -59,13 +68,30 @@ def restore_engine(engine, path: str, verify: bool = True) -> int:
                 m = (mf.verify_manifest(target) if verify
                      else mf.read_manifest(target))
                 payload = mf.payload_path(target, m)
-                turn = engine.load_checkpoint(payload)
+                delta = _reshard.restore_delta(m, engine)
+                if delta and not reshard:
+                    raise _reshard.GeometryMismatch(
+                        f"{target}: checkpoint geometry does not match "
+                        f"this engine ({'; '.join(delta)}); request a "
+                        f"reshard (--reshard / reshard=True) to repack "
+                        f"it")
+                if delta:
+                    span.attrs["reshard"] = "; ".join(delta)
+                    turn = _reshard.reshard_into(engine, m, payload)
+                else:
+                    turn = engine.load_checkpoint(payload)
                 if turn != m["turn"]:
                     # The payload decoded but disagrees with its own
                     # manifest — treat as corruption, refuse the state.
                     raise mf.CheckpointIntegrityError(
                         f"{target}: payload turn {turn} != manifest "
                         f"turn {m['turn']}")
+            elif reshard:
+                # Legacy npz has no manifest geometry to compare, but an
+                # explicit reshard request still routes through the
+                # canonical repack (e.g. a sparse autosave resumed on a
+                # dense engine).
+                turn = _reshard.reshard_into(engine, None, target)
             else:
                 turn = engine.load_checkpoint(target)
         except mf.CheckpointIntegrityError:

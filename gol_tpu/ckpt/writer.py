@@ -63,11 +63,12 @@ class Snapshot:
     carries the sparse window's (size, ox, oy)."""
 
     __slots__ = ("cells", "repr", "pad", "turn", "board", "rule",
-                 "trigger", "extra")
+                 "trigger", "extra", "mesh")
 
     def __init__(self, cells, repr_: str, pad: int, turn: int,
                  board: Tuple[int, int], rule: str,
-                 trigger: str = "periodic", extra: Optional[dict] = None):
+                 trigger: str = "periodic", extra: Optional[dict] = None,
+                 mesh: Optional[dict] = None):
         self.cells = cells
         self.repr = repr_
         self.pad = pad
@@ -76,6 +77,11 @@ class Snapshot:
         self.rule = rule
         self.trigger = trigger if trigger in TRIGGERS else "manual"
         self.extra = dict(extra or {})
+        # The WRITING engine's placement geometry (at least {"devices"}).
+        # Engines stamp this themselves: the global devstats mesh note
+        # may describe a different engine in the same process, and the
+        # reshard-at-restore delta (ckpt/reshard.py) compares it.
+        self.mesh = dict(mesh) if mesh else None
 
 
 def _materialize(snap: Snapshot) -> np.ndarray:
@@ -280,7 +286,7 @@ class CheckpointWriter:
             device = _device_ident()
             if device is not None:
                 manifest["device"] = device
-            mesh_geom = _mesh_ident()
+            mesh_geom = snap.mesh if snap.mesh else _mesh_ident()
             if mesh_geom:
                 manifest["mesh"] = mesh_geom
             fuse_k = _fuse_ident()

@@ -67,8 +67,17 @@ METHOD_RETRY_BUDGETS = {"Ping": 0, "KillProg": 0}
 MUTATING_METHODS = frozenset({
     "CreateRun", "DestroyRun", "SetRule", "Checkpoint", "CFput",
     "DrainFlags", "RestoreRun", "AbortRun", "Profile", "KillProg",
-    "AdoptRun",
+    "AdoptRun", "Rescale", "ReceiveRun", "CommitRun", "PinRun",
 })
+
+
+class GeometryRefused(RuntimeError):
+    """The server refused a restore whose checkpoint geometry does not
+    match its engine (mesh shape, representation family, torus size).
+    Tagged so callers can branch without string-matching; resend with
+    reshard=True to route through the host-side canonical repack."""
+
+    rpc_error_kind = "geometry"
 
 
 def _dial(addr, timeout):
@@ -103,6 +112,15 @@ def _check_resp(resp: dict):
             # transport condition, not an engine state — surface like a
             # network failure so recovery/retry paths apply.
             raise ConnectionError(err)
+        if err.startswith("moved:"):
+            # Live migration (PR 15): the run left this member after our
+            # request was relayed. A TAGGED transport error so the retry
+            # loop re-sends through the router — whose placement is
+            # already pinned at the new owner. Downtime shows up as
+            # latency, never as a caller-visible error.
+            raise _transport_error(err, "moved")
+        if err.startswith("geometry:"):
+            raise GeometryRefused(err)
         raise RuntimeError(f"engine error: {err}")
     return resp
 
@@ -168,6 +186,13 @@ class RemoteEngine:
             try:
                 resp, resp_world = self._call_once(
                     label, header, world, timeout, xrle_basis)
+                self._note_caps(resp)
+                # Inside the try: a server-replied error that
+                # _check_resp converts into a TAGGED ConnectionError
+                # (today: "moved:" after a live migration) retries like
+                # any transport failure. Untagged ConnectionErrors
+                # ("overloaded:") still propagate unretried.
+                _check_resp(resp)
             except ConnectionError as e:
                 kind = getattr(e, "rpc_error_kind", None)
                 if kind is None or attempt >= budget:
@@ -180,8 +205,6 @@ class RemoteEngine:
                             RETRY_BACKOFF_BASE_S * (2 ** (attempt - 1)))
                 time.sleep(delay * (0.5 + random.random() * 0.5))
                 continue
-            self._note_caps(resp)
-            _check_resp(resp)
             return resp, resp_world
 
     def _call_once(self, label: str, header: dict, world, timeout,
@@ -518,13 +541,33 @@ class RemoteEngine:
         resp.pop("ok", None)
         return dict(resp)
 
-    def restore_run(self, path: str = "") -> int:
+    def restore_run(self, path: str = "", reshard: bool = False) -> int:
         """Adopt a checkpoint on the SERVER: empty `path` = the newest
         durable checkpoint in its configured directory, else a
-        checkpoint name within it. Returns the restored turn."""
-        resp, _ = self._call({"method": "RestoreRun", "path": path},
+        checkpoint name within it. Returns the restored turn. A
+        checkpoint whose recorded geometry (mesh shape, representation
+        family, torus size) disagrees with the serving engine is
+        REFUSED with `GeometryRefused` unless `reshard=True`, which
+        repacks it host-side (bit-identical board, new placement)."""
+        resp, _ = self._call({"method": "RestoreRun", "path": path,
+                              "reshard": bool(reshard)},
                              timeout=max(self._timeout, 120.0))
         return int(resp["turn"])
+
+    def rescale(self, run_id: str, target: str) -> dict:
+        """Live-migrate a fleet run to another federation member
+        (`target` = its advertised host:port) via the failure-atomic
+        two-phase cutover: quiesce -> durable checkpoint -> transfer ->
+        resume on target -> router redirect, with rollback to THIS
+        member on any failure. Returns the coordinator's summary
+        record. Generous timeout: the transfer moves the whole board
+        and the redirect waits on the router."""
+        resp, _ = self._call({"method": "Rescale",
+                              "run_id": str(run_id),
+                              "target": str(target)},
+                             timeout=max(self._timeout, 120.0))
+        resp.pop("ok", None)
+        return dict(resp)
 
     # --- Fleet methods (PR 7) --------------------------------------------
 

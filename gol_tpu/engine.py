@@ -881,7 +881,8 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
 
             snap = ckpt_mod.Snapshot(
                 snap_cells, repr_, pad_rows, self._turn, (height, width),
-                self._rule.rulestring, trigger=trigger)
+                self._rule.rulestring, trigger=trigger,
+                mesh={"devices": len(self._devices)})
             try:
                 snap_cells.copy_to_host_async()
             except Exception:
@@ -1563,6 +1564,20 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
     # board would dominate the checkpoint interval for little gain.
     CKPT_COMPRESS_LIMIT = 64 * 1024 * 1024
 
+    def geometry(self) -> dict:
+        """Placement geometry for the reshard-at-restore contract
+        (ckpt/reshard.py): a manifest recording a different mesh or
+        representation family is refused at restore unless a reshard
+        is requested."""
+        with self._state_lock:
+            cells, repr_, pad = self._cells, self._repr, self._pad_rows
+        geo = {"kind": "dense", "devices": len(self._devices)}
+        if cells is not None:
+            geo["h"] = int(cells.shape[-2] - pad)
+            geo["w"] = int(_board_width(cells, repr_))
+            geo["repr"] = repr_
+        return geo
+
     def _ckpt_snapshot(self, trigger: str = "manual"):
         """Capture current state as a ckpt.Snapshot (lock-held pointer
         copy — the expensive work happens in the writer)."""
@@ -1576,7 +1591,8 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
         h = cells.shape[-2] - pad
         w = _board_width(cells, repr_)
         return ckpt_mod.Snapshot(cells, repr_, pad, turn, (h, w),
-                                 self._rule.rulestring, trigger=trigger)
+                                 self._rule.rulestring, trigger=trigger,
+                                 mesh={"devices": len(self._devices)})
 
     def checkpoint_now(self, directory: Optional[str] = None,
                        trigger: str = "manual") -> Tuple[str, int]:
@@ -1602,12 +1618,14 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                                minimum=0))
         return writer.write_sync(snap), snap.turn
 
-    def restore_run(self, path: str) -> int:
+    def restore_run(self, path: str, reshard: bool = False) -> int:
         """Verified manifest/legacy restore (ckpt.restore_engine over
-        this engine); returns the restored turn."""
+        this engine); returns the restored turn. `reshard=True` accepts
+        a checkpoint whose recorded geometry disagrees with this engine
+        by routing it through the host-side canonical repack."""
         from gol_tpu import ckpt as ckpt_mod
 
-        return ckpt_mod.restore_engine(self, path)
+        return ckpt_mod.restore_engine(self, path, reshard=reshard)
 
     def save_checkpoint(self, path: str) -> None:
         """Atomically write the board state + turn + rulestring as .npz.

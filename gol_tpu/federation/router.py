@@ -11,6 +11,8 @@ jobs and deliberately nothing else:
   every live member and merges. Run-scoped RPCs follow the placement
   map, falling back to the HRW owner for runs the router never saw
   created (a restarted router re-derives identical placements).
+  `PinRun` (the live-migration redirect, PR 15) atomically re-points
+  one run's placement at its new owner.
 * **Failover** — a dead member's placed runs are adopted by survivors
   (`AdoptRun` → `FleetEngine.adopt_run` → the PR-10 quarantine→restore
   machinery, reading the per-run `run-<id>/` manifests under the
@@ -76,7 +78,7 @@ DEDUPE_WAIT_S = 60.0
 MUTATING_METHODS = frozenset({
     "CreateRun", "DestroyRun", "SetRule", "Checkpoint", "CFput",
     "DrainFlags", "RestoreRun", "AbortRun", "Profile", "KillProg",
-    "AdoptRun",
+    "AdoptRun", "Rescale", "ReceiveRun", "CommitRun", "PinRun",
 })
 
 
@@ -167,6 +169,13 @@ class FederationRouter:
                 return
             if method == "ListRuns":
                 wire.send_msg(conn, self._list_runs(header))
+                return
+            if method == "PinRun":
+                # Live migration redirect (PR 15): the migration
+                # coordinator re-points a run's placement at its new
+                # owner. Served locally — this IS the atomic authority
+                # flip; every later proxied call follows the new pin.
+                wire.send_msg(conn, self._pin_run(header))
                 return
             self._proxy(conn, header, head_raw, payload, method, t0)
         except (ConnectionError, OSError, wire.WireProtocolError):
@@ -359,12 +368,44 @@ class FederationRouter:
             if rn:
                 wire.relay_payload(msock, conn, rn)
                 return None  # framed replies aren't replayable
+            if str(reply_header.get("error", "")).startswith("moved:"):
+                # A migration-window straggler: the member's answer is
+                # "retry via the new pin", not a commit outcome — it
+                # must never be replayed from the dedupe window or the
+                # client's retry would see "moved:" forever.
+                return None
             return reply_raw
         finally:
             try:
                 msock.close()
             except OSError:
                 pass
+
+    def _pin_run(self, header: dict) -> dict:
+        """Atomically re-point (or insert) a run's placement at a named
+        live member. The single authority flip of a live migration: one
+        dict store under _plock — calls relayed before it land on the
+        source (which answers post-commit stragglers with a retryable
+        "moved:"), calls after it land on the target."""
+        rid = str(header.get("run_id") or "")
+        mid = str(header.get("member_id") or "")
+        if not rid or not mid:
+            return {"error": "PinRun requires run_id and member_id"}
+        member = self.registry.get(mid)
+        if member is None or member.state != "live":
+            return {"error": f"overloaded: member {mid} is not a live "
+                             "federation member"}
+        tt = header.get("target_turn")
+        with self._plock:
+            pl = self._placements.get(rid)
+            prev = pl["member"] if pl else None
+            self._placements[rid] = {
+                "member": mid,
+                "ckpt_every": int(header.get("ckpt_every", 0) or 0),
+                "target_turn": int(tt) if tt is not None else None,
+            }
+        obs_log("fed.pinned", run_id=rid, member=mid, prev=prev)
+        return {"ok": True, "run_id": rid, "member": mid, "prev": prev}
 
     def _record_placement(self, rid: str, header: dict,
                           member_id: str) -> None:

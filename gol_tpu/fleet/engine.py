@@ -105,6 +105,13 @@ QUARANTINE_TRIES_DEFAULT = 3
 QUARANTINE_BACKOFF_ENV = "GOL_QUARANTINE_BACKOFF"
 QUARANTINE_BACKOFF_DEFAULT_S = 0.5
 
+# A staged migration import whose coordinator died (source process
+# killed mid-cutover) must not hold admission budget forever: the
+# fleet loop expires it after this long. Any live cutover commits or
+# rolls it back within GOL_MIGRATE_DEADLINE, well before this fires.
+IMPORT_STALE_ENV = "GOL_MIGRATE_STALE"
+IMPORT_STALE_DEFAULT_S = 60.0
+
 
 def _parse_sizes(raw: str) -> Tuple[int, ...]:
     sizes = tuple(int(s) for s in raw.split(",") if s.strip())
@@ -244,9 +251,14 @@ class FleetEngine(ControlFlagProtocol):
         return RunView(self, handle)
 
     def list_runs(self) -> list:
+        # Staged (un-committed) migration imports are hidden: until
+        # CommitRun flips authority, the SOURCE member's copy is the
+        # one listed copy fleet-wide — never zero, never two.
         with self._fleet_lock:
             return [h.describe() for h in sorted(
-                self._runs.values(), key=lambda h: h.created_s)]
+                self._runs.values(), key=lambda h: h.created_s)
+                if h.migrating is None
+                or not h.migrating.startswith("staged")]
 
     def runs_summary(self) -> dict:
         with self._fleet_lock:
@@ -357,6 +369,11 @@ class FleetEngine(ControlFlagProtocol):
             h = self._runs.get(rid)
             if h is None:
                 raise KeyError(f"unknown run {rid!r}")
+            if h.migrating and not h.migrating.startswith("staged"):
+                # A quiesced source copy is the rollback anchor; only
+                # the migration coordinator may retire it (a staged
+                # TARGET copy is destroyable — that IS the rollback).
+                raise EngineBusy(f"run {rid} is migrating")
             rec = h.describe()
             self._remove_locked(h)
             rec["state"] = h.state
@@ -385,6 +402,20 @@ class FleetEngine(ControlFlagProtocol):
         if not valid_run_id(rid) or rid == LEGACY_RUN_ID:
             self.admission.reject("run_id")
             raise RuntimeError("admission rejected: run_id")
+        with self._fleet_lock:
+            existing = self._runs.get(rid)
+            staged = (existing is not None and
+                      (existing.migrating or "").startswith("staged"))
+        if staged:
+            # This member already holds the run's board as a staged
+            # migration import (the source died mid-cutover, after the
+            # transfer): promote it instead of restoring from the
+            # checkpoint — its board is at the quiesce turn, at least
+            # as new as any durable manifest.
+            obs_log("fleet.adopt_staged", run_id=rid)
+            rec = self.activate_imported(rid)
+            obs.FED_ADOPTED_RUNS.labels(status="ok").inc()
+            return rec
         base = os.environ.get(CKPT_ENV, "")
         if not base:
             raise RuntimeError(
@@ -435,6 +466,223 @@ class FleetEngine(ControlFlagProtocol):
                 rule=run_rule.rulestring, board=f"{h_}x{w_}")
         self._ensure_loop()
         return handle.describe()
+
+    # ------------------------------------------- live migration (PR 15)
+    #
+    # Engine-side halves of the Rescale cutover (gol_tpu/migrate.py is
+    # the coordinator). Authority discipline: quiesce marks the SOURCE
+    # copy migrating (frozen board authoritative, flags deferred,
+    # destroy refused) but it stays the listed owner; import_run stages
+    # a hidden TARGET copy; only commit/rollback resolve which one
+    # survives — at every instant exactly one copy is authoritative.
+
+    def migrate_quiesce(self, run_id: str) -> dict:
+        """Phase 1: freeze a run for transfer. Parks a resident run (a
+        coherent device readback to `frozen` — a mid-drive run pauses
+        exactly as the QUIT flag would; the recorded target_turn rides
+        the transfer so the drive continues on the new owner), pulls a
+        queued run out of the placement queue, and marks the handle
+        migrating with its PRIOR state so rollback restores exactly
+        what quiesce saw. Returns the transfer record (board copy +
+        identity)."""
+        self._check_alive()
+        rid = str(run_id or "")
+        with self._fleet_lock:
+            if rid in ("", LEGACY_RUN_ID):
+                raise PermissionError(
+                    f"run {LEGACY_RUN_ID!r} is the legacy engine "
+                    "surface; it cannot be live-migrated")
+            h = self._runs.get(rid)
+            if h is None:
+                raise KeyError(f"unknown run {rid!r}")
+            if h.migrating is not None:
+                raise EngineBusy(f"run {rid} is already migrating")
+            if h.state == "quarantined" or h in self._waitq:
+                raise EngineBusy(
+                    f"run {rid} is {h.state} (no trusted board to "
+                    "transfer); retry once it is placed")
+            prior = h.state
+            if h.state == "resident":
+                self._park_locked(self._buckets[h.bucket_key], h)
+            elif h.state == "queued":
+                if h in self._placeq:
+                    self._placeq.remove(h)
+                h.state = "parked"
+            if h.frozen is None:
+                raise RuntimeError(
+                    f"run {rid} has no board to transfer")
+            h.migrating = prior
+            self._wake.notify_all()
+            return {
+                "run_id": rid,
+                "board": h.frozen.copy(),
+                "turn": int(h.turn),
+                "rule": h.rule.rulestring,
+                "h": int(h.h), "w": int(h.w),
+                "ckpt_every": int(h.ckpt_every),
+                "target_turn": h.target_turn,
+                "state": prior,
+            }
+
+    def migrate_checkpoint(self, run_id: str,
+                           trigger: str = "migrate"):
+        """Phase 2: durable belt under the transfer — if GOL_CKPT is
+        configured, write a synchronous verified checkpoint of the
+        quiesced board so a crash of BOTH processes mid-migration still
+        resumes from this exact turn (adoption path). No-op without a
+        checkpoint root."""
+        if not os.environ.get(CKPT_ENV, ""):
+            return None
+        with self._fleet_lock:
+            h = self._runs.get(str(run_id or ""))
+            if h is None:
+                raise KeyError(f"unknown run {run_id!r}")
+        return self._ckpt_sync(h, None, trigger)
+
+    def migrate_commit(self, run_id: str) -> list:
+        """Final phase on the SOURCE: retire the migrated-away copy and
+        hand back any control flags that arrived while quiesced (the
+        coordinator relays them to the new owner). Idempotent: a second
+        commit (or a commit after rollback already ran) returns []."""
+        with self._fleet_lock:
+            h = self._runs.get(str(run_id or ""))
+            if h is None or h.migrating is None:
+                return []
+            flags = []
+            while True:
+                try:
+                    flags.append(h.flags.get_nowait())
+                except queue_mod.Empty:
+                    break
+            h.migrating = None
+            self._remove_locked(h)
+            self._wake.notify_all()
+        return flags
+
+    def migrate_rollback(self, run_id: str) -> dict:
+        """Undo a quiesce: restore the run to the state migrate_quiesce
+        recorded. Idempotent — a handle that is gone or not migrating
+        reports {"restored": False} and changes nothing."""
+        with self._fleet_lock:
+            h = self._runs.get(str(run_id or ""))
+            if h is None or h.migrating is None:
+                return {"restored": False}
+            prior = h.migrating
+            h.migrating = None
+            if (prior in ("resident", "queued")
+                    and h.state == "parked"
+                    and (h.target_turn is None
+                         or h.turn < h.target_turn)):
+                # frozen reseeds the slot (or the placement queue for a
+                # never-placed run) on the next service pass; a drive
+                # the quiesce park disarmed re-arms. A run quiesced
+                # resident AT its target stays parked — the loop only
+                # parks armed drives, so resuming it would free-run it
+                # past the target.
+                self._resume_locked(h)
+                if h.target_turn is not None:
+                    h.done.clear()
+            self._wake.notify_all()
+            rec = h.describe()
+        rec["restored"] = True
+        return rec
+
+    def import_run(self, run_id: str, board: np.ndarray, turn: int,
+                   rule=None, ckpt_every: int = 0,
+                   target_turn: Optional[int] = None,
+                   activate: bool = True) -> dict:
+        """TARGET half of the transfer: stage a migrated-in run. The
+        board is admitted and registered parked+hidden ("staged") —
+        invisible to list_runs and never auto-resumed — until CommitRun
+        activates it. `activate=False` stages "staged-parked": commit
+        leaves the run parked, preserving a source run that was itself
+        parked (it must not start advancing because it moved)."""
+        self._check_alive()
+        rid = str(run_id or "")
+        if not valid_run_id(rid) or rid == LEGACY_RUN_ID:
+            self.admission.reject("run_id")
+            raise RuntimeError("admission rejected: run_id")
+        run_rule = self._resolve_rule(rule)
+        board01 = np.asarray(board)
+        h_, w_ = int(board01.shape[-2]), int(board01.shape[-1])
+        board01 = self._board01(board01, h_, w_)
+        size = choose_bucket_size(h_, w_, self.bucket_sizes)
+        if size is None:
+            self.admission.reject("shape")
+            raise RuntimeError(
+                "admission rejected: shape (board sides must divide a "
+                f"bucket class {self.bucket_sizes})")
+        cost = run_cost(size, size // WORD_BITS)
+        handle = RunHandle(rid, run_rule, h_, w_,
+                           ckpt_every=int(ckpt_every),
+                           target_turn=target_turn,
+                           start_turn=int(turn))
+        handle.bucket_key = (size, size, run_rule.rulestring)
+        handle.frozen = board01
+        handle.alive = int(board01.sum())
+        handle.alive_turn = handle.turn
+        handle.admitted_cost = cost
+        handle.state = "parked"
+        handle.migrating = "staged" if activate else "staged-parked"
+        # A staged run with a target_turn must NOT look drivable to the
+        # loop (it would auto-resume before commit) — done is only
+        # cleared by a real drive request after activation.
+        handle.done.set()
+        with self._fleet_lock:
+            if rid in self._runs:
+                self.admission.reject("run_id")
+                raise RuntimeError("admission rejected: run_id")
+            ok, reason = self.admission.try_admit(cost)
+            if not ok:
+                self.admission.reject(reason or "unknown")
+                raise RuntimeError(f"admission rejected: {reason}")
+            self._runs[rid] = handle
+        obs_log("fleet.import", run_id=rid, turn=handle.turn,
+                rule=run_rule.rulestring, board=f"{h_}x{w_}")
+        return handle.describe()
+
+    def activate_imported(self, run_id: str) -> dict:
+        """CommitRun on the TARGET: flip a staged import live. "staged"
+        queues the run for placement (it resumes stepping where the
+        source parked it); "staged-parked" stays parked — readable,
+        not advancing — exactly as it was on the source."""
+        self._check_alive()
+        rid = str(run_id or "")
+        with self._fleet_lock:
+            h = self._runs.get(rid)
+            if h is None:
+                raise KeyError(f"unknown run {rid!r}")
+            if not (h.migrating or "").startswith("staged"):
+                raise RuntimeError(
+                    f"run {rid} is not a staged import")
+            staged = h.migrating
+            h.migrating = None
+            if staged == "staged":
+                if (h.target_turn is not None
+                        and h.turn >= h.target_turn):
+                    # The source was resident only transiently (e.g.
+                    # quiesced between placement and the loop's
+                    # target-park pass). Nothing left to step — and the
+                    # loop only parks runs with an ARMED drive, so
+                    # queueing this one would free-run it past its
+                    # target forever. Activate parked instead.
+                    h.state = "parked"
+                else:
+                    h.state = "queued"
+                    self._placeq.append(h)
+                    # Re-arm the drive the staging deliberately
+                    # disarmed: a run migrated mid-flight resumes
+                    # stepping toward its original target.
+                    if h.target_turn is not None:
+                        h.done.clear()
+                    self._wake.notify_all()
+            rec = h.describe()
+        self._ensure_loop()
+        return rec
+
+    def geometry(self) -> dict:
+        """Placement stamp for checkpoint manifests / reshard deltas."""
+        return {"kind": "fleet", "devices": len(self._devices)}
 
     def set_rule(self, run_id: str, rule) -> dict:
         """Migrate a fleet run to a new life-like rule WITHOUT dropping
@@ -856,23 +1104,27 @@ class FleetEngine(ControlFlagProtocol):
         if h.frozen is not None and (h.paused or h.state != "resident"):
             if h.w % WORD_BITS == 0:
                 cells = np.ascontiguousarray(board_to_words(h.frozen))
-                return ckpt_mod.Snapshot(cells, "packed", 0, h.turn,
-                                         board_meta, rulestring,
-                                         trigger=trigger)
-            return ckpt_mod.Snapshot(h.frozen.copy(), "u8", 0, h.turn,
-                                     board_meta, rulestring,
-                                     trigger=trigger)
+                return ckpt_mod.Snapshot(
+                    cells, "packed", 0, h.turn, board_meta, rulestring,
+                    trigger=trigger,
+                    mesh={"devices": len(self._devices)})
+            return ckpt_mod.Snapshot(
+                h.frozen.copy(), "u8", 0, h.turn, board_meta,
+                rulestring, trigger=trigger,
+                mesh={"devices": len(self._devices)})
         bucket = self._buckets[h.bucket_key]
         if h.w % WORD_BITS == 0:
             cells = bucket.slot_words(h.slot)[:, : h.w // WORD_BITS]
             if h.h < bucket.hb:
                 cells = cells[: h.h]
-            return ckpt_mod.Snapshot(cells, "packed", 0, h.turn,
-                                     board_meta, rulestring,
-                                     trigger=trigger)
+            return ckpt_mod.Snapshot(
+                cells, "packed", 0, h.turn, board_meta, rulestring,
+                trigger=trigger,
+                mesh={"devices": len(self._devices)})
         board = bucket.read_board(h.slot, h.h, h.w)
-        return ckpt_mod.Snapshot(board, "u8", 0, h.turn, board_meta,
-                                 rulestring, trigger=trigger)
+        return ckpt_mod.Snapshot(
+            board, "u8", 0, h.turn, board_meta, rulestring,
+            trigger=trigger, mesh={"devices": len(self._devices)})
 
     def _ckpt_cadence_locked(self, h: RunHandle) -> None:
         """Async per-run cadence checkpoint (loop thread, lock held):
@@ -894,10 +1146,10 @@ class FleetEngine(ControlFlagProtocol):
             keep_every=env_int(ckpt_mod.CKPT_KEEP_EVERY_ENV, 0,
                                minimum=0))
 
-    def restore_run(self, path: str) -> int:
+    def restore_run(self, path: str, reshard: bool = False) -> int:
         from gol_tpu import ckpt as ckpt_mod
 
-        return ckpt_mod.restore_engine(self, path)
+        return ckpt_mod.restore_engine(self, path, reshard=reshard)
 
     def save_checkpoint(self, path: str) -> None:
         """Legacy .npz autosave of run0 (SIGTERM handler parity)."""
@@ -1377,6 +1629,18 @@ class FleetEngine(ControlFlagProtocol):
         for h in list(self._runs.values()):
             if h.state == "removed":
                 continue
+            if ((h.migrating or "").startswith("staged")
+                    and time.time() - h.created_s
+                    > env_float(IMPORT_STALE_ENV,
+                                IMPORT_STALE_DEFAULT_S)):
+                # Orphaned import: its migration coordinator never came
+                # back to commit or roll back. The source (or adopter)
+                # copy is authoritative; this hidden copy only holds
+                # budget — expire it.
+                obs_log("fleet.import_expired", level="warning",
+                        run_id=h.run_id, turn=h.turn)
+                self._remove_locked(h)
+                continue
             if h.state == "quarantined":
                 self._service_quarantined_locked(h)
             if h.pending_seed is not None:
@@ -1431,6 +1695,11 @@ class FleetEngine(ControlFlagProtocol):
                 self._alive_pub = (h.alive, h.turn)
 
     def _service_flags_locked(self, h: RunHandle) -> None:
+        # Flags arriving mid-migration are DEFERRED, not dropped: the
+        # coordinator drains them at commit and relays them to the new
+        # owner (or rollback leaves them queued for local service).
+        if h.migrating is not None:
+            return
         while True:
             try:
                 flag = h.flags.get_nowait()
@@ -1608,17 +1877,14 @@ class FleetEngine(ControlFlagProtocol):
         target = latest[1]
         m = mf.verify_manifest(target)
         payload = mf.payload_path(target, m)
-        with np.load(payload) as z:
-            turn = int(z["turn"])
-            if "world" in z.files:
-                board01 = (np.asarray(z["world"]) != 0).astype(np.uint8)
-            elif "words" in z.files:
-                words = np.asarray(z["words"])
-                board01 = words_to_board(words, words.shape[-2],
-                                         int(z["width"]))
-            else:
-                raise ValueError(
-                    f"unsupported payload members: {sorted(z.files)}")
+        # Canonical decode (ckpt/reshard.py) instead of a members
+        # switch: any representation family an engine can write —
+        # packed words, raw pixels, sparse windows — restores here.
+        from gol_tpu.ckpt import reshard as reshard_mod
+
+        can = reshard_mod.load_canonical(payload)
+        board01 = reshard_mod.board01_of(can)
+        turn = int(can.turn)
         if board01.shape != (h.h, h.w):
             raise ValueError(
                 f"checkpoint board {board01.shape} does not match "

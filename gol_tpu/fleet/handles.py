@@ -75,7 +75,7 @@ class RunHandle:
         "done", "created_s", "pending_seed", "abort",
         "admitted_cost", "enqueued_s", "advanced_s",
         "quarantine_reason", "quarantine_tries", "quarantine_next_s",
-        "adopted",
+        "adopted", "migrating",
     )
 
     def __init__(self, run_id: str, rule, h: int, w: int,
@@ -134,6 +134,14 @@ class RunHandle:
         # whose first restore hasn't resolved yet — the quarantine
         # service meters its outcome under gol_fed_adopted_runs_total.
         self.adopted = False
+        # Live migration (PR 15). On the SOURCE member: the run's state
+        # before migrate_quiesce ("resident"/"queued"/"parked"), so
+        # rollback restores exactly that; control flags are deferred
+        # while set. On the TARGET member: "staged"/"staged-parked"
+        # until CommitRun activates the import — staged handles are
+        # hidden from list_runs (exactly one listed copy fleet-wide)
+        # and never auto-resumed. None = not migrating.
+        self.migrating: Optional[str] = None
 
     @property
     def active(self) -> bool:
@@ -162,6 +170,8 @@ class RunHandle:
         if self.quarantine_reason is not None:
             rec["quarantine_reason"] = self.quarantine_reason
             rec["quarantine_tries"] = self.quarantine_tries
+        if self.migrating is not None:
+            rec["migrating"] = self.migrating
         return rec
 
 

@@ -1,0 +1,318 @@
+"""Live run migration: the Rescale coordinator (PR 15).
+
+Moves ONE fleet run from this member to another federation member with
+no caller-visible errors, via a failure-atomic two-phase cutover run
+inside the SOURCE member's server process:
+
+    quiesce    - park the run (coherent device readback to the handle),
+                 mark it migrating: control flags defer, destroy is
+                 refused, reads keep serving from the frozen board
+    checkpoint - synchronous durable per-run checkpoint (belt under the
+                 transfer: if BOTH processes die, the federation's
+                 adoption path resumes from this exact turn)
+    transfer   - stream the board to the target over the ordinary wire
+                 codec (CAP_PACKED frame) as ReceiveRun; the target
+                 STAGES it — registered, hidden from ListRuns, never
+                 auto-resumed
+    resume     - CommitRun flips the staged copy live on the target
+                 (queued for placement, or parked if the source run was
+                 parked)
+    redirect   - PinRun atomically re-points the router placement at
+                 the target; the source retires its copy, relays any
+                 deferred control flags to the new owner, and re-keys
+                 viewers (broadcast end sentinel -> subscribers
+                 reconnect through the router's new pin)
+
+Rollback: any failure BEFORE the redirect pin lands unwinds in reverse
+— destroy the staged/committed target copy (best effort, retried),
+restore the source run to the exact state quiesce recorded — and the
+migration reports status="rolled_back". Authority lives in the router
+placement map and flips exactly once, at PinRun: before it, the source
+copy is the one listed, routable copy (the target's is staged-hidden);
+after it, the target's is, and the source answers stragglers with a
+retryable "moved:" error until its dedupe-window peers drain. At no
+instant are there zero or two routable copies.
+
+GOL_CHAOS `migrate_fail=<phase>` injects a one-shot failure at any
+phase boundary; `kill_member=<addr>@migrating` lets a harness SIGKILL
+the source mid-migration (chaos.take_kill_member polls True while a
+Rescale is in flight). docs/ARCHITECTURE.md "Live migration & elastic
+resharding" is the narrative version with the phase diagram.
+
+Env:
+
+    GOL_MIGRATE_DEADLINE   total wall budget in seconds (default 30);
+                           each member/router RPC gets the remainder
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from gol_tpu import chaos, wire
+from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import trace
+from gol_tpu.obs.log import exception as obs_exception
+from gol_tpu.obs.log import log as obs_log
+from gol_tpu.utils.envcfg import env_float
+
+DEADLINE_ENV = "GOL_MIGRATE_DEADLINE"
+DEADLINE_DEFAULT_S = 30.0
+
+# The cutover's phase names, in order — chaos specs and trace spans use
+# them verbatim (GOL_CHAOS=migrate_fail=transfer).
+PHASES = ("quiesce", "checkpoint", "transfer", "resume", "redirect")
+
+# Client-visible cutover wall samples (resume+redirect slice) feeding
+# the gol_migration_downtime_ms{q} gauges: bounded, process-wide.
+_DOWNTIME_S: "deque[float]" = deque(maxlen=256)
+_DOWNTIME_LOCK = threading.Lock()
+
+# True while any Rescale is in flight on this process — the harness's
+# kill_member@migrating poll (bench chaos leg) keys off the "migrating"
+# field in Stats/healthz surfaces built from this.
+_IN_FLIGHT = 0
+_IN_FLIGHT_LOCK = threading.Lock()
+
+
+class MigrationFailed(RuntimeError):
+    """A phase failed; the cutover was rolled back to the source."""
+
+
+def in_flight() -> int:
+    """How many Rescale cutovers this process is coordinating now."""
+    with _IN_FLIGHT_LOCK:
+        return _IN_FLIGHT
+
+
+def _publish_downtime(seconds: float) -> None:
+    with _DOWNTIME_LOCK:
+        _DOWNTIME_S.append(seconds)
+        samples = sorted(_DOWNTIME_S)
+    n = len(samples)
+    for q, frac in zip(obs.SLO_QUANTILES, (0.50, 0.95, 0.99)):
+        v = samples[min(n - 1, int(frac * n))]
+        obs.MIGRATION_DOWNTIME_MS.labels(q=q).set(round(v * 1e3, 3))
+
+
+def _rpc(addr: str, header: dict, frame=None,
+         timeout: Optional[float] = None) -> dict:
+    """One direct wire round trip to `addr` ("host:port"). Raises on
+    transport failure or an error reply — phase code treats any raise
+    as that phase failing."""
+    host, _, port = addr.rpartition(":")
+    sock = socket.create_connection((host or "127.0.0.1", int(port)),
+                                    timeout=min(timeout or 5.0, 5.0))
+    try:
+        wire.enable_nodelay(sock)
+        sock.settimeout(timeout)
+        wire.send_msg(sock, header, frame=frame)
+        resp, _ = wire.recv_msg(sock)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+    if resp.get("error") or not resp.get("ok", True):
+        raise RuntimeError(
+            f"{header.get('method')} to {addr}: "
+            f"{resp.get('error', 'refused')}")
+    return resp
+
+
+def _chaos_gate(phase: str) -> None:
+    if chaos.take_migrate_fail(phase):
+        raise RuntimeError(f"chaos: migrate_fail at phase {phase!r}")
+
+
+def rescale(server, run_id: str, target: str) -> dict:
+    """Coordinate one live migration of `run_id` from `server`'s engine
+    to the member advertised at `target` ("host:port" — also its
+    federation member_id). Returns a summary record; raises
+    MigrationFailed after a rollback, or the underlying error if even
+    the rollback could not restore the source."""
+    engine = server.engine
+    if getattr(engine, "migrate_quiesce", None) is None:
+        from gol_tpu.fleet.handles import FleetUnsupported
+
+        raise FleetUnsupported(
+            f"{type(engine).__name__} serves a single run; start the "
+            "server with --fleet for Rescale")
+    rid = str(run_id or "")
+    target = str(target or "")
+    if not target or ":" not in target:
+        raise ValueError(f"Rescale needs a target member host:port, "
+                         f"got {target!r}")
+    # Unknown run BEFORE the self-target check: "run X is already on
+    # me" about a run this member has never heard of would mask the
+    # real problem (and the server's unknown-run branch is what turns
+    # this KeyError into the retryable moved:/unknown answer).
+    engine.resolve_run(rid)
+    self_addr = getattr(server, "_self_addr", "") or ""
+    if target == self_addr:
+        raise ValueError(f"run {rid} is already on {target}")
+    deadline = time.monotonic() + env_float(DEADLINE_ENV,
+                                            DEADLINE_DEFAULT_S)
+
+    def remaining() -> float:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise RuntimeError(
+                f"migrate deadline ({DEADLINE_ENV}) exceeded")
+        return left
+
+    global _IN_FLIGHT
+    with _IN_FLIGHT_LOCK:
+        _IN_FLIGHT += 1
+    # Every RPC this ATTEMPT sends carries a nonce in its req_id. The
+    # ids must be unique per attempt, not per run: a run that migrates
+    # to a member it has visited before (ping-pong, retry after a
+    # coordinator death) would otherwise have its ReceiveRun/CommitRun
+    # answered from that member's dedupe window — "ok" replayed, no
+    # copy actually staged — and the source would then retire the only
+    # real copy.
+    nonce = uuid.uuid4().hex[:12]
+    staged_on_target = False
+    quiesced = None
+    try:
+        with trace.span("migrate", attrs={"run_id": rid,
+                                          "target": target}) as root:
+            # -- quiesce ------------------------------------------------
+            with trace.span("migrate.quiesce"):
+                _chaos_gate("quiesce")
+                quiesced = engine.migrate_quiesce(rid)
+            # -- checkpoint ---------------------------------------------
+            with trace.span("migrate.checkpoint"):
+                _chaos_gate("checkpoint")
+                engine.migrate_checkpoint(rid)
+            # -- transfer -----------------------------------------------
+            with trace.span("migrate.transfer"):
+                _chaos_gate("transfer")
+                px = (quiesced["board"] *
+                      np.uint8(255)).astype(np.uint8)
+                frame = wire.encode_board(
+                    px, frozenset({wire.CAP_PACKED}), binary=True)
+                header = {
+                    "method": "ReceiveRun", "run_id": rid,
+                    "turn": quiesced["turn"],
+                    "rule": quiesced["rule"],
+                    "ckpt_every": quiesced["ckpt_every"],
+                    "state": quiesced["state"],
+                    "req_id": f"mig-{rid}-{nonce}-recv",
+                    "caps": sorted(wire.local_caps()),
+                }
+                if quiesced["target_turn"] is not None:
+                    header["target_turn"] = quiesced["target_turn"]
+                _rpc(target, header, frame=frame, timeout=remaining())
+                staged_on_target = True
+            # -- resume -------------------------------------------------
+            t_cut = time.monotonic()
+            with trace.span("migrate.resume"):
+                _chaos_gate("resume")
+                _rpc(target, {"method": "CommitRun", "run_id": rid,
+                              "req_id": f"mig-{rid}-{nonce}-commit"},
+                     timeout=remaining())
+            # -- redirect -----------------------------------------------
+            with trace.span("migrate.redirect"):
+                _chaos_gate("redirect")
+                # Stragglers relayed to us before the pin flips get a
+                # RETRYABLE "moved:" answer once our copy retires —
+                # registered before anything can observe the removal.
+                server.note_moved(rid, target)
+                router = getattr(server, "_fed_router", "") or ""
+                if router:
+                    pin = {"method": "PinRun", "run_id": rid,
+                           "member_id": target,
+                           "ckpt_every": quiesced["ckpt_every"],
+                           "req_id": f"mig-{rid}-{nonce}-pin"}
+                    if quiesced["target_turn"] is not None:
+                        pin["target_turn"] = quiesced["target_turn"]
+                    _rpc(router, pin, timeout=remaining())
+            downtime_s = time.monotonic() - t_cut
+            # -- commit (source retire; past the point of no return) ----
+            flags = engine.migrate_commit(rid)
+            for flag in flags:
+                try:
+                    _rpc(target, {"method": "CFput", "flag": int(flag),
+                                  "run_id": rid,
+                                  "req_id": f"mig-{rid}-{nonce}-cf-{flag}",
+                                  }, timeout=5.0)
+                except Exception as e:
+                    obs_exception("migrate.flag_relay_failed", e,
+                                  run_id=rid, flag=flag)
+            # Viewer re-key: per-viewer xrle bases die with the source
+            # copy; broadcast subscribers get the end sentinel and
+            # reconnect through the router's new pin (epoch-bump
+            # keyframe on the target's stream).
+            server.drop_run_viewers(
+                rid, f"killed: run {rid} migrated to {target}")
+            _publish_downtime(downtime_s)
+            root.attrs["downtime_ms"] = round(downtime_s * 1e3, 3)
+        obs.MIGRATIONS.labels(status="ok").inc()
+        obs_log("migrate.ok", run_id=rid, target=target,
+                turn=quiesced["turn"],
+                downtime_ms=round(downtime_s * 1e3, 3),
+                relayed_flags=len(flags))
+        return {"run_id": rid, "target": target,
+                "turn": int(quiesced["turn"]),
+                "downtime_ms": round(downtime_s * 1e3, 3),
+                "status": "ok"}
+    except Exception as e:
+        failed_phase = _rollback(engine, rid, target, staged_on_target,
+                                 quiesced, e, nonce)
+        raise MigrationFailed(
+            f"migration of run {rid} to {target} rolled back "
+            f"({failed_phase}): {e}") from e
+    finally:
+        with _IN_FLIGHT_LOCK:
+            _IN_FLIGHT -= 1
+
+
+def _rollback(engine, rid: str, target: str, staged_on_target: bool,
+              quiesced, cause: Exception, nonce: str) -> str:
+    """Unwind in reverse: destroy the target's staged/committed copy,
+    then restore the source run to its pre-quiesce state. Meters
+    rolled_back on success, error when the source could not be
+    restored (the invariant breach worth paging on)."""
+    obs_exception("migrate.failed", cause, run_id=rid, target=target)
+    if staged_on_target:
+        for attempt in (1, 2):
+            try:
+                _rpc(target, {"method": "DestroyRun", "run_id": rid,
+                              "req_id": f"mig-{rid}-{nonce}-undo-"
+                                        f"{attempt}"},
+                     timeout=5.0)
+                break
+            except Exception as e:
+                obs_exception("migrate.rollback_destroy_failed", e,
+                              run_id=rid, target=target,
+                              attempt=attempt)
+                # Unknown-run means the copy never landed (or already
+                # expired) — nothing to destroy.
+                if "unknown run" in str(e):
+                    break
+                time.sleep(0.05)
+    status = "rolled_back"
+    try:
+        if quiesced is not None:
+            rec = engine.migrate_rollback(rid)
+            if not rec.get("restored"):
+                # Source handle gone mid-rollback (operator destroy is
+                # refused while migrating, so this means the engine
+                # died) — nothing to restore into.
+                status = "error"
+    except Exception as e:
+        status = "error"
+        obs_exception("migrate.rollback_failed", e, run_id=rid)
+    obs.MIGRATIONS.labels(status=status).inc()
+    obs_log("migrate.rolled_back", level="warning", run_id=rid,
+            target=target, status=status,
+            cause=f"{type(cause).__name__}: {cause}")
+    return status

@@ -20,6 +20,7 @@ WIRE_METHODS = (
     "GetMetrics", "Checkpoint", "RestoreRun", "Profile",
     "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "SetRule",
     "RegisterMember", "AdoptRun", "Subscribe",
+    "Rescale", "ReceiveRun", "CommitRun", "PinRun",
     "unknown",
 )
 
@@ -186,7 +187,7 @@ def method_label(method: str) -> str:
 # Closed kind sets, pre-seeded like the wire methods so the resilience
 # families are visible at zero before the first fault.
 CHAOS_KINDS = ("drop", "delay", "truncate", "corrupt", "stall",
-               "kill_member", "refuse")
+               "kill_member", "refuse", "migrate_fail")
 RPC_ERROR_KINDS = ("timeout", "refused", "reset", "protocol")
 
 CHAOS_INJECTED = REGISTRY.counter(
@@ -198,7 +199,8 @@ CHAOS_INJECTED = REGISTRY.counter(
     "protocol error), stall (long sleep that outlasts read timeouts), "
     "refuse (dial-time ConnectionRefusedError before the socket "
     "connects), kill_member (process-level SIGKILL of a federation "
-    "member at a seeded time). Stays 0 unless GOL_CHAOS is set.",
+    "member at a seeded time), migrate_fail (one forced failure at a "
+    "named Rescale migration phase). Stays 0 unless GOL_CHAOS is set.",
     label_names=("kind",))
 for _k in CHAOS_KINDS:
     CHAOS_INJECTED.labels(kind=_k)
@@ -460,6 +462,43 @@ FED_ROUTER_OVERHEAD_MS = REGISTRY.gauge(
     label_names=("q",))
 for _q in SLO_QUANTILES:
     FED_ROUTER_OVERHEAD_MS.labels(q=_q)
+
+
+# ------------------------------------------- live migration & resharding
+
+# Terminal outcomes of one Rescale cutover. Closed set, pre-seeded:
+# ok (target authoritative, source copy retired), rolled_back (a phase
+# failed before the redirect committed; the source copy resumed),
+# error (rollback itself failed — the source copy is still present and
+# authoritative, but the failure needed operator-visible attribution).
+MIGRATION_STATUSES = ("ok", "rolled_back", "error")
+
+MIGRATIONS = REGISTRY.counter(
+    "gol_migrations_total",
+    "Rescale live-migration attempts completed by this process's "
+    "migration coordinator, by terminal status: ok (two-phase cutover "
+    "committed — the run now lives on the target member and the router "
+    "placement is pinned there), rolled_back (a phase failed or a "
+    "GOL_CHAOS migrate_fail fault fired before the redirect committed; "
+    "the staged target copy was destroyed and the source copy resumed "
+    "authoritative), error (rollback was itself incomplete; exactly-one-"
+    "owner still holds — the source was never released — but the "
+    "attempt needs attention).",
+    label_names=("status",))
+for _s in MIGRATION_STATUSES:
+    MIGRATIONS.labels(status=_s)
+
+MIGRATION_DOWNTIME_MS = REGISTRY.gauge(
+    "gol_migration_downtime_ms",
+    "Quantiles of the redirect-slice wall time in milliseconds across "
+    "completed migrations: the window between pinning the router "
+    "placement at the target and retiring the source copy (with viewer "
+    "re-key), during which a racing run-scoped RPC is answered with a "
+    "retryable 'moved:' redirect instead of an error — downtime is "
+    "latency, never an error.",
+    label_names=("q",))
+for _q in SLO_QUANTILES:
+    MIGRATION_DOWNTIME_MS.labels(q=_q)
 
 
 # ------------------------------------------------- broadcast tier & gateway

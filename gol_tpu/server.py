@@ -13,6 +13,7 @@ Run:  python -m gol_tpu.server [--port 8080]
 from __future__ import annotations
 
 import argparse
+import collections
 import os
 import socket
 import threading
@@ -103,6 +104,18 @@ class EngineServer:
         # socket to the gateway: _serve_conn's finally must then NOT
         # close the fd the event loop now owns.
         self._adopted_conn = threading.local()
+        # Live migration forwarding map (PR 15): run_id -> the member
+        # address it migrated to. A straggler whose request was relayed
+        # here before the router's pin flipped gets a RETRYABLE
+        # "moved:" answer instead of "unknown run". Bounded LRU.
+        self._moved: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._moved_lock = threading.Lock()
+        # Federation identity, stamped by main() when --federate is on:
+        # the router address (PinRun destination) and the address this
+        # member advertised (its member_id in the registry).
+        self._fed_router = ""
+        self._self_addr = ""
 
     VIEW_CACHE_MAX = 4
     DEDUPE_MAX = 512
@@ -114,8 +127,33 @@ class EngineServer:
     MUTATING_METHODS = frozenset({
         "CreateRun", "DestroyRun", "SetRule", "Checkpoint", "CFput",
         "DrainFlags", "RestoreRun", "AbortRun", "Profile", "KillProg",
-        "AdoptRun",
+        "AdoptRun", "Rescale", "ReceiveRun", "CommitRun", "PinRun",
     })
+
+    MOVED_MAX = 256
+
+    def note_moved(self, run_id: str, target: str) -> None:
+        """Record that `run_id` now lives at member `target` so late
+        requests answer "moved:" (retryable) instead of "unknown run"."""
+        with self._moved_lock:
+            self._moved[str(run_id)] = str(target)
+            self._moved.move_to_end(str(run_id))
+            while len(self._moved) > self.MOVED_MAX:
+                self._moved.popitem(last=False)
+
+    def moved_to(self, run_id: str) -> Optional[str]:
+        with self._moved_lock:
+            return self._moved.get(str(run_id))
+
+    def drop_run_viewers(self, run_id: str, sentinel: str) -> None:
+        """Purge every per-viewer xrle basis for a run that left this
+        server and end its broadcast streams with `sentinel` — each
+        subscriber reconnects and re-keys (first frame after any
+        reconnect is a keyframe by protocol)."""
+        self._drop_run_views(run_id)
+        bc = self._bcast
+        if bc is not None:
+            bc[0].drop_run(run_id, sentinel)
 
     def serve_forever(self) -> None:
         while not self._shutdown.is_set():
@@ -661,8 +699,53 @@ class EngineServer:
                     target_turn=int(tt) if tt is not None else None)
                 self._reply(conn, {"ok": True, "run": rec})
             elif method == "RestoreRun":
-                turn = self._restore_run(str(header.get("path", "")))
+                turn = self._restore_run(
+                    str(header.get("path", "")),
+                    reshard=bool(header.get("reshard", False)))
                 self._reply(conn, {"ok": True, "turn": turn})
+            elif method == "Rescale":
+                # Live migration (PR 15): THIS member is the source;
+                # gol_tpu/migrate.py coordinates the failure-atomic
+                # quiesce -> checkpoint -> transfer -> resume ->
+                # redirect cutover (rollback to here on any failure).
+                from gol_tpu import migrate as migrate_mod
+
+                rec = migrate_mod.rescale(
+                    self, str(header.get("run_id") or ""),
+                    str(header.get("target") or ""))
+                self._reply(conn, {"ok": True, **rec})
+            elif method == "ReceiveRun":
+                # Target half of a migration transfer: stage the
+                # incoming board hidden ("staged") until CommitRun.
+                from gol_tpu.fleet.handles import FleetUnsupported
+
+                imp = getattr(self.engine, "import_run", None)
+                if imp is None:
+                    raise FleetUnsupported(
+                        f"{type(self.engine).__name__} serves a single "
+                        "run; start the server with --fleet for "
+                        "ReceiveRun")
+                if world is None:
+                    raise RuntimeError(
+                        "ReceiveRun carries the board as its payload")
+                tt = header.get("target_turn")
+                rec = imp(
+                    str(header.get("run_id") or ""), world,
+                    int(header.get("turn", 0)),
+                    rule=header.get("rule"),
+                    ckpt_every=int(header.get("ckpt_every", 0) or 0),
+                    target_turn=int(tt) if tt is not None else None,
+                    activate=str(header.get("state", "resident"))
+                    in ("resident", "queued"))
+                self._reply(conn, {"ok": True, "run": rec})
+            elif method == "CommitRun":
+                act = getattr(self.engine, "activate_imported", None)
+                if act is None:
+                    raise RuntimeError(
+                        f"{type(self.engine).__name__} cannot commit "
+                        "migrated runs")
+                rec = act(str(header.get("run_id") or ""))
+                self._reply(conn, {"ok": True, "run": rec})
             elif method == "Profile":
                 # Arm an on-demand jax.profiler capture of the next N
                 # engine turns, into the server's CONFIGURED directory
@@ -701,7 +784,19 @@ class EngineServer:
             obs.SERVER_ERRORS.labels(method=label).inc()
             msg = e.args[0] if e.args else ""
             if isinstance(msg, str) and msg.startswith("unknown run"):
-                self._reply(conn, {"ok": False, "error": msg})
+                # Unknown because it MIGRATED away? Answer with the
+                # retryable redirect — by the time the client retries,
+                # the router pin points at the new owner. Downtime is
+                # latency, never a caller-visible error.
+                moved = self.moved_to(str(header.get("run_id") or ""))
+                if moved is not None:
+                    self._reply(conn, {
+                        "ok": False,
+                        "error": f"moved: run "
+                                 f"{header.get('run_id')} migrated "
+                                 f"to {moved}"})
+                else:
+                    self._reply(conn, {"ok": False, "error": msg})
             else:
                 self._reply(conn, {"ok": False,
                                    "error": f"KeyError: {e}"})
@@ -716,10 +811,17 @@ class EngineServer:
             self._reply(conn, {"ok": False, "error": f"busy: {e}"})
         except Exception as e:  # surface engine errors to the client
             obs.SERVER_ERRORS.labels(method=label).inc()
-            self._reply(conn,
-                        {"ok": False, "error": f"{type(e).__name__}: {e}"})
+            if getattr(e, "rpc_error_kind", None) == "geometry":
+                # Mismatched-geometry restore refusal (ckpt/reshard.py):
+                # a tagged, never-retried error the client surfaces as
+                # GeometryRefused — resend with reshard=True to repack.
+                self._reply(conn, {"ok": False,
+                                   "error": f"geometry: {e}"})
+            else:
+                self._reply(conn, {"ok": False,
+                                   "error": f"{type(e).__name__}: {e}"})
 
-    def _restore_run(self, req: str) -> int:
+    def _restore_run(self, req: str, reshard: bool = False) -> int:
         """RestoreRun target resolution: the request names a checkpoint
         WITHIN the server's configured directory (relative name, or an
         absolute path that realpath-resolves inside it) — or nothing,
@@ -739,7 +841,7 @@ class EngineServer:
                 and not real_target.startswith(real_base + os.sep)):
             raise PermissionError(
                 f"restore path {req!r} escapes the checkpoint directory")
-        return self.engine.restore_run(target)
+        return self.engine.restore_run(target, reshard=reshard)
 
 
 def _final_flush(reason: str) -> None:
@@ -775,6 +877,13 @@ def main() -> None:
                          "checkpoint directory (newest durable manifest "
                          "wins), a ckpt-*.json manifest (payload "
                          "SHA-256 verified), or a legacy .npz autosave")
+    ap.add_argument("--reshard", action="store_true",
+                    help="allow --resume to adopt a checkpoint whose "
+                         "recorded geometry (mesh device count, "
+                         "representation family, sparse torus size) "
+                         "differs from this engine: the payload is "
+                         "repacked host-side, bit-identically; without "
+                         "this flag a mismatched resume is refused")
     ap.add_argument("--checkpoint", metavar="DIR", default="",
                     help="checkpoint directory (sets GOL_CKPT): runs "
                          "write gol-ckpt/1 manifest checkpoints here "
@@ -882,8 +991,10 @@ def main() -> None:
         eng = Engine(rule=rule)
     srv = EngineServer(port=args.port, host=args.host, engine=eng)
     if args.resume:
-        turn = srv.engine.restore_run(args.resume)
-        print(f"restored checkpoint {args.resume} at turn {turn}")
+        turn = srv.engine.restore_run(args.resume,
+                                      reshard=args.reshard)
+        print(f"restored checkpoint {args.resume} at turn {turn}"
+              + (" (resharded)" if args.reshard else ""))
 
     # Graceful shutdown: with checkpointing configured (GOL_CKPT), a
     # SIGTERM writes one final checkpoint before exiting, so an orderly
@@ -959,8 +1070,12 @@ def main() -> None:
         from gol_tpu.federation.agent import FederationAgent
 
         devices = len(np.atleast_1d(srv.engine._devices))
+        # Stamp the federation identity on the server: the Rescale
+        # coordinator pins redirects at this router, as this member.
+        srv._fed_router = args.federate
+        srv._self_addr = f"{args.advertise}:{srv.port}"
         agent = FederationAgent(
-            args.federate, f"{args.advertise}:{srv.port}",
+            args.federate, srv._self_addr,
             capacity=devices, mesh={"devices": devices}).start()
     # This exact banner is the readiness contract: harnesses parse
     # "serving on :<port>" from stdout to learn the bound port.

@@ -415,7 +415,8 @@ class SparseEngine(SingleRunSurface, ControlFlagProtocol):
         return ckpt_mod.Snapshot(
             packed, "sparse", 0, turn, (self.size, self.size),
             self._rule.rulestring, trigger=trigger,
-            extra={"size": self.size, "ox": ox, "oy": oy})
+            extra={"size": self.size, "ox": ox, "oy": oy},
+            mesh={"devices": len(self._devices)})
 
     def checkpoint_now(self, directory: Optional[str] = None,
                        trigger: str = "manual") -> Tuple[str, int]:
@@ -438,11 +439,21 @@ class SparseEngine(SingleRunSurface, ControlFlagProtocol):
                                minimum=0))
         return writer.write_sync(snap), snap.turn
 
-    def restore_run(self, path: str) -> int:
-        """Verified manifest/legacy restore; returns the restored turn."""
+    def geometry(self) -> dict:
+        """Placement geometry for the reshard-at-restore contract
+        (ckpt/reshard.py): the torus size is fixed at construction, so
+        a mismatched checkpoint is refused unless resharded (and even
+        then must decode to exactly this torus)."""
+        return {"kind": "sparse", "devices": len(self._devices),
+                "size": int(self.size)}
+
+    def restore_run(self, path: str, reshard: bool = False) -> int:
+        """Verified manifest/legacy restore; returns the restored turn.
+        `reshard=True` routes a geometry-mismatched checkpoint through
+        the host-side canonical repack."""
         from gol_tpu import ckpt as ckpt_mod
 
-        return ckpt_mod.restore_engine(self, path)
+        return ckpt_mod.restore_engine(self, path, reshard=reshard)
 
     def save_checkpoint(self, path: str) -> None:
         """Atomic .npz of (window words, origin, torus size, turn,

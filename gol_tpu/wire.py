@@ -787,6 +787,14 @@ def recv_msg(sock: socket.socket,
 # across the proxy — the member sees the client's exact bytes, and vice
 # versa. None of this touches send_msg/recv_msg: a routerless
 # single-server deployment stays byte-for-byte unchanged on the wire.
+#
+# Live migration (PR 15) rides the same framing: a Rescale transfer
+# ships the board as an ordinary CAP_PACKED frame (ReceiveRun), and a
+# source member answering after its copy retired replies a "moved:"
+# error — which the client treats as a TAGGED transport failure and
+# retries through the router, whose PinRun placement already points at
+# the new owner. The router never records "moved:" replies in its
+# dedupe window (they are routing artifacts, not commit outcomes).
 
 def recv_head_raw(sock: socket.socket) -> Tuple[dict, bytes]:
     """Receive one message's framed header WITHOUT consuming its board

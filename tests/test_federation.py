@@ -75,6 +75,9 @@ class StubMember:
     def __init__(self):
         self.calls = collections.Counter()
         self.req_ids = []
+        # When set, run-scoped calls are answered with the retryable
+        # "moved:" redirect a retired migration source emits (PR 15).
+        self.moved_to = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", 0))
@@ -100,7 +103,11 @@ class StubMember:
             if header.get("req_id"):
                 self.req_ids.append((method, header["req_id"]))
             rid = header.get("run_id", "r")
-            if method in ("CreateRun", "AdoptRun"):
+            if self.moved_to and header.get("run_id"):
+                wire.send_msg(conn, {
+                    "error": f"moved: run {rid} migrated to "
+                             f"{self.moved_to}"})
+            elif method in ("CreateRun", "AdoptRun"):
                 wire.send_msg(conn, {
                     "ok": True,
                     "run": {"run_id": rid, "state": "running",
@@ -221,6 +228,78 @@ def test_router_dedupe_survives_member_failover(cluster):
                                 "h": 64, "w": 64, "ckpt_every": 0,
                                 "req_id": "req-fo2"})
     assert fresh["run"]["served_by"] == survivor.address
+
+
+def test_router_dedupe_survives_redirect(cluster):
+    """PR 15 satellite: the req_id window must survive a PinRun
+    redirect. A mutate recorded before the pin replays from the window
+    (the NEW owner never re-executes it), while fresh run-scoped calls
+    follow the pin to the new owner."""
+    router, stubs, _ = cluster
+    by_addr = {s.address: s for s in stubs}
+    owner = by_addr[hrw.place("mig1", [s.address for s in stubs])]
+    target = next(s for s in stubs if s is not owner)
+    header = {"method": "CreateRun", "run_id": "mig1", "h": 64,
+              "w": 64, "ckpt_every": 4, "req_id": "req-mig1"}
+    first = _call(router.port, dict(header))
+    assert first["ok"] and first["run"]["served_by"] == owner.address
+    # The reply streams to the client before the router records the
+    # placement — wait for it (real migrations start long after).
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline \
+            and "mig1" not in router._placements:
+        time.sleep(0.02)
+
+    # The migration coordinator's redirect phase: one atomic re-point.
+    pin = _call(router.port, {"method": "PinRun", "run_id": "mig1",
+                              "member_id": target.address,
+                              "req_id": "req-mig1-pin"})
+    assert pin["ok"] and pin["member"] == target.address
+    assert pin["prev"] == owner.address
+
+    # Retry of the pre-redirect mutate: recorded-reply replay — the
+    # target member must NOT see a CreateRun.
+    retried = _call(router.port, dict(header))
+    assert retried == first
+    assert target.calls["CreateRun"] == 0
+
+    # A fresh run-scoped call follows the pin to the new owner.
+    fresh = _call(router.port, {"method": "Ping", "run_id": "mig1"})
+    assert fresh["ok"]
+    assert target.calls["Ping"] == 1 and owner.calls["Ping"] == 0
+
+
+def test_router_pin_refuses_unknown_member(cluster):
+    router, _, _ = cluster
+    resp = _call(router.port, {"method": "PinRun", "run_id": "x1",
+                               "member_id": "10.9.9.9:1"})
+    assert "not a live" in resp.get("error", "")
+
+
+def test_router_moved_reply_not_pinned_in_dedupe(cluster):
+    """A "moved:" reply from a just-retired migration source must never
+    be recorded in the dedupe window: the client retries the SAME
+    req_id, and the retry must land on the new owner — not replay the
+    redirect error forever."""
+    router, stubs, _ = cluster
+    by_addr = {s.address: s for s in stubs}
+    owner = by_addr[hrw.place("mv1", [s.address for s in stubs])]
+    target = next(s for s in stubs if s is not owner)
+    pin_at = {"method": "PinRun", "run_id": "mv1"}
+    assert _call(router.port, {**pin_at,
+                               "member_id": owner.address})["ok"]
+    owner.moved_to = target.address
+    header = {"method": "CFput", "run_id": "mv1", "flag": 2,
+              "req_id": "req-mv1-cf"}
+    first = _call(router.port, dict(header))
+    assert str(first.get("error", "")).startswith("moved:")
+    # The redirect lands (what the real coordinator does next), and the
+    # client's retry of the SAME req_id now reaches the new owner.
+    assert _call(router.port, {**pin_at,
+                               "member_id": target.address})["ok"]
+    retried = _call(router.port, dict(header))
+    assert retried.get("ok")
+    assert target.calls["CFput"] == 1
 
 
 def test_router_lists_and_registers_members(cluster):

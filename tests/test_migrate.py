@@ -1,0 +1,361 @@
+"""Live run migration (PR 15, gol_tpu/migrate.py): the failure-atomic
+quiesce -> checkpoint -> transfer -> resume -> redirect cutover.
+
+Engine-level tests pin the staging/rollback state machine on one
+FleetEngine; the end-to-end tests run TWO real fleet servers behind a
+FederationRouter and migrate a live run between them through the
+public Rescale wire method — parity vs the device torus replay, the
+router pin flip, the retryable "moved:" answer for stragglers, and a
+per-phase chaos sweep where every injected failure must end in a
+rollback with the source run intact and exactly one authoritative
+copy."""
+
+import os
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import chaos, migrate, wire
+from gol_tpu.client import RemoteEngine
+from gol_tpu.engine import FLAG_PAUSE
+from gol_tpu.federation.router import FederationRouter
+from gol_tpu.fleet import FleetEngine
+from gol_tpu.fleet.engine import EngineBusy
+from gol_tpu.models import CONWAY
+from gol_tpu.ops.bitpack import (
+    pack_np,
+    packed_run_turns,
+    unpack_np,
+    words_bytes_np,
+)
+from gol_tpu.server import EngineServer
+
+
+def _soup(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
+def _replay(seed01, turns, rule=CONWAY):
+    h, w = seed01.shape
+    assert w % 32 == 0
+    words = packed_run_turns(pack_np(seed01).view("<u4"), turns, rule)
+    return unpack_np(words_bytes_np(np.asarray(words)), h, w)
+
+
+def _wait(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _rec(eng, rid):
+    return next((r for r in eng.list_runs()
+                 if r["run_id"] == rid), None)
+
+
+# ----------------------------------------- engine state machine
+
+
+@pytest.fixture()
+def fleet():
+    eng = FleetEngine(bucket_sizes=(64,), chunk_turns=2, slot_base=2)
+    try:
+        yield eng
+    finally:
+        eng.kill_prog()
+
+
+def test_quiesce_parks_defers_flags_and_rolls_back(fleet):
+    seed01 = _soup(64, 64, seed=1)
+    fleet.create_run(64, 64, board=seed01, run_id="q1",
+                     target_turn=6)
+    _wait(lambda: (_rec(fleet, "q1") or {}).get("state") == "parked",
+          what="q1 parked")
+    # Re-arm it as a resident free-runner to quiesce mid-flight: a
+    # parked run quiesces trivially, so test the parked path too.
+    q = fleet.migrate_quiesce("q1")
+    assert q["state"] == "parked" and q["turn"] == 6
+    np.testing.assert_array_equal(q["board"], _replay(seed01, 6))
+    rec = _rec(fleet, "q1")
+    assert rec.get("migrating") == "parked"
+
+    # While migrating: destroy refused, second quiesce refused, flags
+    # deferred (queued on the handle, not applied, not dropped).
+    with pytest.raises(EngineBusy):
+        fleet.destroy_run("q1")
+    with pytest.raises(EngineBusy):
+        fleet.migrate_quiesce("q1")
+    fleet.resolve_run("q1").cf_put(FLAG_PAUSE)
+
+    back = fleet.migrate_rollback("q1")
+    assert back["restored"] and back["state"] == "parked"
+    assert _rec(fleet, "q1").get("migrating") is None
+    # The deferred flag is handed to the commit path only; after a
+    # rollback it drains through normal service (still queued here).
+    flags = fleet.migrate_commit("q1")  # not migrating: no-op
+    assert flags == []
+
+
+def test_commit_retires_run_and_returns_deferred_flags(fleet):
+    seed01 = _soup(64, 64, seed=2)
+    fleet.create_run(64, 64, board=seed01, run_id="c1",
+                     target_turn=4)
+    _wait(lambda: (_rec(fleet, "c1") or {}).get("state") == "parked",
+          what="c1 parked")
+    fleet.migrate_quiesce("c1")
+    fleet.resolve_run("c1").cf_put(FLAG_PAUSE)
+    flags = fleet.migrate_commit("c1")
+    assert flags == [FLAG_PAUSE]
+    assert _rec(fleet, "c1") is None
+    # Idempotent: both post-retire calls are safe no-ops.
+    assert fleet.migrate_commit("c1") == []
+    assert fleet.migrate_rollback("c1") == {"restored": False}
+
+
+def test_import_stages_hidden_then_commit_activates(fleet):
+    board01 = _replay(_soup(64, 64, seed=3), 8)
+    rec = fleet.import_run("i1", board01, 8, ckpt_every=0,
+                           target_turn=20, activate=True)
+    assert rec.get("migrating") == "staged" and rec["turn"] == 8
+    # Hidden from list_runs; destroy of a STAGED copy is allowed (it is
+    # exactly what rollback does when the cutover fails).
+    assert _rec(fleet, "i1") is None
+    with pytest.raises(RuntimeError, match="run_id"):
+        fleet.import_run("i1", board01, 8)  # duplicate stage refused
+
+    live = fleet.activate_imported("i1")
+    assert live.get("migrating") is None
+    _wait(lambda: (_rec(fleet, "i1") or {}).get("state") == "parked"
+          and _rec(fleet, "i1")["turn"] == 20,
+          what="activated import resumed to target_turn")
+    board, t = fleet.resolve_run("i1").get_world()
+    assert t == 20
+    np.testing.assert_array_equal(
+        (board != 0).astype(np.uint8),
+        _replay(_soup(64, 64, seed=3), 20))
+
+
+def test_import_parked_variant_stays_parked(fleet):
+    board01 = _soup(64, 64, seed=4)
+    fleet.import_run("p1", board01, 5, activate=False)
+    rec = fleet.activate_imported("p1")
+    assert rec["state"] == "parked" and rec.get("migrating") is None
+    time.sleep(0.3)
+    assert _rec(fleet, "p1")["turn"] == 5  # not advancing
+
+
+def test_staged_import_destroyable_and_expires(fleet, monkeypatch):
+    monkeypatch.setenv("GOL_MIGRATE_STALE", "0.3")
+    board01 = _soup(64, 64, seed=5)
+    fleet.import_run("d1", board01, 1)
+    fleet.destroy_run("d1")  # rollback's path: allowed while staged
+    assert fleet._runs.get("d1") is None  # gone outright, not hidden
+    # An orphaned stage (source died before commit OR rollback) is
+    # garbage-collected after GOL_MIGRATE_STALE seconds.
+    fleet.import_run("d2", board01, 1)
+    fleet.create_run(64, 64, board=board01, run_id="tick",
+                     target_turn=2)  # keeps the service loop spinning
+    _wait(lambda: fleet._runs.get("d2") is None, timeout=15,
+          what="staged import expiry")
+
+
+def test_adopt_promotes_staged_import(fleet):
+    """kill_member@migrating recovery: the source dies after transfer,
+    the router adopts the run onto the target — which already holds the
+    staged board at the quiesce turn. Adoption must promote it in
+    place, not re-read checkpoints."""
+    board01 = _replay(_soup(64, 64, seed=6), 9)
+    fleet.import_run("a1", board01, 9, activate=True)
+    rec = fleet.adopt_run("a1")
+    assert rec.get("migrating") is None
+    assert _rec(fleet, "a1") is not None  # listed: authoritative
+
+
+# ----------------------------------------- two-member federation
+
+
+@pytest.fixture()
+def duo(monkeypatch, tmp_path):
+    """Router + two real fleet servers heartbeating as members."""
+    monkeypatch.setenv("GOL_FED_HEARTBEAT", "0.1")
+    monkeypatch.setenv("GOL_FED_DEAD_AFTER", "1.0")
+    monkeypatch.setenv("GOL_FED_REROUTE", "10")
+    monkeypatch.setenv("GOL_CKPT", str(tmp_path / "ck"))
+    router = FederationRouter(port=0).start_background()
+    servers = []
+    for _ in range(2):
+        srv = EngineServer(
+            port=0, host="127.0.0.1",
+            engine=FleetEngine(bucket_sizes=(64,), chunk_turns=2,
+                               slot_base=2))
+        srv.start_background()
+        srv._fed_router = f"127.0.0.1:{router.port}"
+        srv._self_addr = f"127.0.0.1:{srv.port}"
+        servers.append(srv)
+    stop = threading.Event()
+
+    def beat():
+        seq = 0
+        while not stop.is_set():
+            seq += 1
+            for srv in servers:
+                router.registry.register(srv._self_addr,
+                                         srv._self_addr, seq)
+            stop.wait(0.1)
+
+    t = threading.Thread(target=beat, daemon=True)
+    t.start()
+    _wait(lambda: router.registry.members_doc()["live"] == 2,
+          what="both members live")
+    try:
+        yield router, servers
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        router.shutdown()
+        for srv in servers:
+            try:
+                srv.shutdown()
+            except Exception:
+                pass
+            srv.engine.kill_prog()
+
+
+def _locate(router, servers, rid):
+    """(source_server, target_server) per the router's placement."""
+    pl = router._placements.get(rid)
+    assert pl is not None, f"router never placed {rid}"
+    src = next(s for s in servers if s._self_addr == pl["member"])
+    dst = next(s for s in servers if s is not src)
+    return src, dst
+
+
+def test_rescale_end_to_end_parity_and_redirect(duo):
+    router, servers = duo
+    cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=30.0)
+    seed01 = _soup(64, 64, seed=31)
+    cli.create_run(64, 64, board=seed01, run_id="mig-e2e",
+                   ckpt_every=4, target_turn=12)
+    _wait(lambda: "mig-e2e" in router._placements,
+          what="placement recorded")
+    run_cli = cli.for_run("mig-e2e")
+    _wait(lambda: run_cli.get_world()[1] == 12,
+          what="run parked at turn 12")
+    src, dst = _locate(router, servers, "mig-e2e")
+
+    rec = cli.rescale("mig-e2e", dst._self_addr)
+    assert rec["status"] == "ok" and rec["turn"] == 12
+    assert rec["downtime_ms"] >= 0
+
+    # Exactly one authoritative copy: gone from the source, listed on
+    # the target, and the router pin points at the target.
+    assert _rec(src.engine, "mig-e2e") is None
+    assert _rec(dst.engine, "mig-e2e")["turn"] == 12
+    assert router._placements["mig-e2e"]["member"] == dst._self_addr
+
+    # Routed reads keep working and the board is bit-identical to the
+    # torus replay — migration moved placement, not state.
+    board, t = run_cli.get_world()
+    assert t == 12
+    np.testing.assert_array_equal((board != 0).astype(np.uint8),
+                                  _replay(seed01, 12))
+
+    # The source answers stragglers with the retryable "moved:" error.
+    import socket as socket_mod
+    with socket_mod.create_connection(
+            ("127.0.0.1", src.port), timeout=5) as s:
+        wire.send_msg(s, {"method": "Ping", "run_id": "mig-e2e"})
+        resp, _ = wire.recv_msg(s)
+    assert str(resp.get("error", "")).startswith("moved:")
+
+    # Post-migration the run is still drivable on its new home.
+    dst.engine.resolve_run("mig-e2e")  # resolvable
+    mets = migrate._DOWNTIME_S
+    assert len(mets) >= 1
+
+
+def test_rescale_resident_run_keeps_advancing(duo):
+    """A free-running (resident) run migrates mid-flight and keeps
+    advancing on the target along the same trajectory."""
+    router, servers = duo
+    cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=30.0)
+    seed01 = _soup(64, 64, seed=32)
+    cli.create_run(64, 64, board=seed01, run_id="mig-live",
+                   target_turn=4000)
+    _wait(lambda: "mig-live" in router._placements,
+          what="placement recorded")
+    src, dst = _locate(router, servers, "mig-live")
+    _wait(lambda: (_rec(src.engine, "mig-live") or {}).get("turn", 0)
+          > 4, what="run advancing on source")
+
+    rec = cli.rescale("mig-live", dst._self_addr)
+    assert rec["status"] == "ok"
+    t0 = rec["turn"]
+    _wait(lambda: (_rec(dst.engine, "mig-live") or {}).get("turn", 0)
+          > t0, what="run advancing on target")
+    board, t = cli.for_run("mig-live").get_world()
+    np.testing.assert_array_equal((board != 0).astype(np.uint8),
+                                  _replay(seed01, t))
+
+
+def test_rescale_rejects_bad_targets(duo):
+    router, servers = duo
+    cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=30.0)
+    cli.create_run(64, 64, board=_soup(64, 64, seed=33),
+                   run_id="mig-bad", target_turn=2)
+    _wait(lambda: "mig-bad" in router._placements,
+          what="placement recorded")
+    src, _ = _locate(router, servers, "mig-bad")
+    with pytest.raises(RuntimeError, match="already on"):
+        cli.rescale("mig-bad", src._self_addr)
+    with pytest.raises(RuntimeError, match="unknown run"):
+        cli.rescale("nope", servers[1]._self_addr)
+
+
+@pytest.mark.parametrize("phase", migrate.PHASES)
+def test_rescale_chaos_rollback_each_phase(duo, monkeypatch, phase):
+    """GOL_CHAOS=migrate_fail=<phase>: every injected mid-migration
+    failure ends in a rollback — the source run is intact (and still on
+    trajectory), the target holds no listed copy, the router pin never
+    flipped, and the failure is the tagged MigrationFailed error."""
+    router, servers = duo
+    cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=30.0)
+    rid = f"mig-x-{phase}"
+    seed01 = _soup(64, 64, seed=40 + len(phase))
+    cli.create_run(64, 64, board=seed01, run_id=rid, target_turn=10)
+    _wait(lambda: rid in router._placements, what="placement recorded")
+    run_cli = cli.for_run(rid)
+    _wait(lambda: run_cli.get_world()[1] == 10,
+          what="run parked at turn 10")
+    src, dst = _locate(router, servers, rid)
+
+    # The injector is memoized per raw spec string — a fresh value
+    # arms a fresh one-shot for this phase.
+    monkeypatch.setenv("GOL_CHAOS", f"migrate_fail={phase}")
+    try:
+        with pytest.raises(RuntimeError, match="rolled back"):
+            cli.rescale(rid, dst._self_addr)
+    finally:
+        monkeypatch.delenv("GOL_CHAOS")
+
+    # Exactly one live authoritative copy: the SOURCE one.
+    rec = _rec(src.engine, rid)
+    assert rec is not None and rec.get("migrating") is None
+    assert _rec(dst.engine, rid) is None
+    assert router._placements[rid]["member"] == src._self_addr
+    # A staged leftover on the target (redirect-phase failure destroys
+    # a COMMITTED copy) must be gone outright, not merely hidden.
+    assert dst.engine._runs.get(rid) is None
+    # The run still reads, and still on the reference trajectory —
+    # downtime is latency, never error or corruption.
+    board, t = run_cli.get_world()
+    assert t == 10
+    np.testing.assert_array_equal((board != 0).astype(np.uint8),
+                                  _replay(seed01, 10))

@@ -1,0 +1,308 @@
+"""Reshard-at-restore (PR 15, gol_tpu/ckpt/reshard.py): resume any
+checkpoint onto any geometry, bit-identically.
+
+Covers: canonical decode round-trips for every writer representation,
+the geometry refusal contract (tagged rpc_error_kind="geometry", over
+the wire too), mesh-mismatched checkpoints resharding onto 1/2/8-way
+engines with identical boards, and the fleet-bucket <-> dense
+single-run round trip — all checked against the device torus replay or
+the numpy reference oracle."""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import ckpt
+from gol_tpu.ckpt import manifest as mf
+from gol_tpu.ckpt import reshard
+from gol_tpu.ckpt.restore import restore_engine
+from gol_tpu.client import GeometryRefused, RemoteEngine
+from gol_tpu.engine import Engine
+from gol_tpu.fleet import FleetEngine
+from gol_tpu.ops.bitpack import pack_np, packed_run_turns, unpack_np, \
+    words_bytes_np
+from gol_tpu.ops.reference import run_turns_np
+from gol_tpu.params import Params
+from gol_tpu.server import EngineServer
+
+
+def _soup(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
+def _replay(seed01, turns):
+    h, w = seed01.shape
+    assert w % 32 == 0
+    words = packed_run_turns(pack_np(seed01).view("<u4"), turns)
+    return unpack_np(words_bytes_np(np.asarray(words)), h, w)
+
+
+def _write_ckpt(dirpath, cells, repr_, turn, board_shape,
+                rule="B3/S23", extra=None):
+    snap = ckpt.Snapshot(cells, repr_, 0, turn, board_shape, rule,
+                        extra=extra)
+    w = ckpt.CheckpointWriter(str(dirpath), run_id="test", keep_last=9)
+    return w.write_sync(snap)
+
+
+def _stamp_mesh(manifest_path, devices):
+    """Re-stamp a manifest's recorded mesh — simulates a checkpoint
+    written by a `devices`-way process. The payload (and its SHA) are
+    untouched: geometry is manifest metadata, not board state."""
+    m = mf.read_manifest(manifest_path)
+    m["mesh"] = {"devices": int(devices), "shape": [int(devices)],
+                 "axes": ["x"]}
+    mf.write_manifest(manifest_path, m)
+
+
+# ------------------------------------------------- canonical decode
+
+
+def test_canonical_roundtrip_packed(tmp_path):
+    board01 = _soup(16, 64, seed=2)
+    words = pack_np(board01).view("<u4")
+    path = _write_ckpt(tmp_path, words, "packed", 9, (16, 64))
+    payload = mf.payload_path(path, mf.read_manifest(path))
+    can = reshard.load_canonical(payload)
+    assert (can.kind, can.turn, can.rule) == ("life", 9, "B3/S23")
+    np.testing.assert_array_equal(reshard.board01_of(can), board01)
+
+
+def test_canonical_roundtrip_u8_pixels(tmp_path):
+    board01 = _soup(16, 16, seed=3)
+    path = _write_ckpt(tmp_path, board01, "u8", 4, (16, 16))
+    payload = mf.payload_path(path, mf.read_manifest(path))
+    can = reshard.load_canonical(payload)
+    assert can.kind == "pixels" and can.turn == 4
+    np.testing.assert_array_equal(reshard.board01_of(can), board01)
+
+
+def test_canonical_roundtrip_sparse_window(tmp_path):
+    """A sparse window embeds into its full torus with wraparound —
+    the canonical board is the torus, not the window."""
+    size, oy, ox = 64, 58, 50  # wraps both axes
+    win01 = _soup(16, 32, seed=4)
+    words = pack_np(win01).view("<u4")
+    path = _write_ckpt(tmp_path, words, "sparse", 7, (16, 32),
+                       extra={"size": size, "ox": ox, "oy": oy})
+    payload = mf.payload_path(path, mf.read_manifest(path))
+    can = reshard.load_canonical(payload)
+    assert can.kind == "life" and can.board.shape == (size, size)
+    want = np.zeros((size, size), dtype=np.uint8)
+    rows = (np.arange(16) + oy) % size
+    cols = (np.arange(32) + ox) % size
+    want[np.ix_(rows, cols)] = win01
+    np.testing.assert_array_equal(can.board, want)
+    assert int(can.board.sum()) == int(win01.sum())
+
+
+def test_canonical_generations_has_no_binary_form(tmp_path):
+    state = (_soup(8, 8, seed=5) * 2).astype(np.uint8)
+    path = _write_ckpt(tmp_path, state, "gen8", 3, (8, 8),
+                       rule="B3/S23/3")
+    payload = mf.payload_path(path, mf.read_manifest(path))
+    can = reshard.load_canonical(payload)
+    assert can.kind == "gen"
+    np.testing.assert_array_equal(can.board, state)
+    with pytest.raises(reshard.GeometryMismatch):
+        reshard.board01_of(can)
+
+
+# ------------------------------------------- geometry refusal + repack
+
+
+def test_mesh_mismatch_refused_unless_reshard(tmp_path):
+    """The satellite contract: restoring a 4-way checkpoint on this
+    (1-way) engine refuses with the tagged geometry error; the same
+    call with reshard=True installs it bit-identically and the resumed
+    run stays on the reference trajectory."""
+    seed01 = _soup(32, 64, seed=11)
+    eng = Engine()
+    p = Params(threads=1, image_width=64, image_height=32, turns=20)
+    out, turn = eng.server_distributor(p, seed01 * np.uint8(255))
+    assert turn == 20
+    path = _write_ckpt(tmp_path, (out != 0).astype(np.uint8), "u8",
+                       20, out.shape)
+    eng2 = Engine()
+    ndev = eng2.geometry()["devices"]
+    stamped = 4 if ndev != 4 else 2  # any count this host ISN'T
+    _stamp_mesh(path, devices=stamped)
+
+    with pytest.raises(reshard.GeometryMismatch) as ei:
+        restore_engine(eng2, path)
+    assert getattr(ei.value, "rpc_error_kind") == "geometry"
+    assert f"mesh devices {stamped} -> {ndev}" in str(ei.value)
+
+    assert restore_engine(eng2, path, reshard=True) == 20
+    snap, t = eng2.get_world()
+    assert t == 20
+    np.testing.assert_array_equal((snap != 0).astype(np.uint8),
+                                  run_turns_np(seed01, 20))
+    # Resume 10 more turns on the new geometry: still the reference
+    # trajectory — resharding changed placement, not state.
+    p2 = Params(threads=1, image_width=64, image_height=32, turns=10)
+    out2, turn2 = eng2.server_distributor(p2, snap, start_turn=20)
+    assert turn2 == 30
+    np.testing.assert_array_equal((out2 != 0).astype(np.uint8),
+                                  run_turns_np(seed01, 30))
+
+
+class _StubEngine:
+    """Geometry-only engine: claims a device count, records what the
+    repack hands its load_checkpoint. Lets one test cover target mesh
+    shapes this CPU host can't actually build."""
+
+    def __init__(self, devices):
+        self._devices = devices
+        self.board01 = None
+        self.turn = None
+
+    def geometry(self):
+        return {"kind": "dense", "devices": self._devices}
+
+    def load_checkpoint(self, path):
+        can = reshard.load_canonical(path)
+        self.board01 = reshard.board01_of(can)
+        self.turn = can.turn
+        return can.turn
+
+
+@pytest.mark.parametrize("devices", [1, 2, 8])
+def test_reshard_4way_checkpoint_onto_any_device_count(tmp_path,
+                                                       devices):
+    """A 4-way packed checkpoint resharded onto 1/2/8-way engines hands
+    every one of them the SAME board bytes — the torus is
+    device-count-invariant, only the halo partitioning changes."""
+    board01 = _replay(_soup(32, 64, seed=13), 20)
+    words = pack_np(board01).view("<u4")
+    path = _write_ckpt(tmp_path, words, "packed", 20, (32, 64))
+    _stamp_mesh(path, devices=4)
+
+    stub = _StubEngine(devices)
+    with pytest.raises(reshard.GeometryMismatch):
+        restore_engine(stub, path)
+    assert restore_engine(stub, path, reshard=True) == 20
+    np.testing.assert_array_equal(stub.board01, board01)
+
+    same = _StubEngine(4)  # matching mesh: direct load, no repack
+    assert restore_engine(same, path) == 20
+    np.testing.assert_array_equal(same.board01, board01)
+
+
+def test_sparse_size_mismatch_named_in_delta(tmp_path):
+    board01 = _soup(16, 32, seed=6)
+    words = pack_np(board01).view("<u4")
+    path = _write_ckpt(tmp_path, words, "sparse", 2, (16, 32),
+                       extra={"size": 64, "ox": 0, "oy": 0})
+    m = mf.read_manifest(path)
+
+    class _Sparse(_StubEngine):
+        def geometry(self):
+            return {"kind": "sparse", "devices": self._devices,
+                    "size": 128}
+
+    delta = reshard.restore_delta(m, _Sparse(1))
+    assert any("sparse torus 64 -> 128" in d for d in delta)
+
+
+# ----------------------------------------- fleet bucket <-> dense
+
+
+def _wait(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_fleet_bucket_checkpoint_restores_on_dense_and_back(
+        tmp_path, monkeypatch):
+    """The bucket-repr leg: a per-run fleet checkpoint (packed payload
+    cropped out of a shared bucket) restores onto a dense single-run
+    engine bit-identically vs the torus replay; a dense checkpoint of
+    the evolved state then restores back into a (fresh) fleet engine."""
+    monkeypatch.setenv("GOL_CKPT", str(tmp_path / "fleet-ck"))
+    seed01 = _soup(64, 64, seed=21)
+    fleet = FleetEngine(bucket_sizes=(64,), chunk_turns=2, slot_base=2)
+
+    def _rec(rid):
+        return next((r for r in fleet.list_runs()
+                     if r["run_id"] == rid), None)
+
+    try:
+        fleet.create_run(64, 64, board=seed01, run_id="r2d",
+                         ckpt_every=0, target_turn=10)
+        _wait(lambda: (_rec("r2d") or {}).get("state") == "parked",
+              what="run parked at target turn")
+        assert _rec("r2d")["turn"] == 10
+        fleet.migrate_checkpoint("r2d", trigger="manual")
+    finally:
+        fleet.kill_prog()
+    manifests = glob.glob(str(tmp_path / "fleet-ck" / "*r2d*" /
+                              "ckpt-*.json"))
+    assert manifests, "fleet sync checkpoint did not land"
+
+    # reshard=True tolerates whatever device count this host runs the
+    # fleet vs dense engines at; with matching geometry it is a direct
+    # load, with differing counts the host-side repack — bit-identical
+    # either way.
+    dense = Engine()
+    turn = restore_engine(dense, manifests[0], reshard=True)
+    assert turn == 10
+    snap, t = dense.get_world()
+    want10 = _replay(seed01, 10)
+    np.testing.assert_array_equal((snap != 0).astype(np.uint8), want10)
+
+    # ... and back: a dense u8 checkpoint of the evolved board resumes
+    # as a fleet run (the legacy --resume path on a --fleet server).
+    back = _write_ckpt(tmp_path / "dense-ck",
+                       (snap != 0).astype(np.uint8), "u8", 10,
+                       snap.shape)
+    fleet2 = FleetEngine(bucket_sizes=(64,), chunk_turns=2,
+                         slot_base=2)
+    try:
+        assert fleet2.restore_run(back, reshard=True) == 10
+        # The legacy run free-runs after restore: whatever turn the
+        # snapshot catches, it must sit on the seed's torus trajectory.
+        board2, t2 = fleet2.get_world()
+        assert t2 >= 10
+        np.testing.assert_array_equal(
+            (board2 != 0).astype(np.uint8), _replay(seed01, t2))
+    finally:
+        fleet2.kill_prog()
+
+
+# ------------------------------------------------- over the wire
+
+
+def test_restore_run_geometry_refusal_over_wire(tmp_path, monkeypatch):
+    """Satellite 1: RestoreRun/--resume with mismatched geometry
+    refuses with the tagged `geometry:` wire error (GeometryRefused at
+    the client, never retried) unless the caller requests a reshard."""
+    monkeypatch.setenv("GOL_CKPT", str(tmp_path))
+    seed01 = _soup(32, 64, seed=17)
+    path = _write_ckpt(tmp_path, seed01, "u8", 0, seed01.shape)
+    eng = Engine()
+    ndev = eng.geometry()["devices"]
+    _stamp_mesh(path, devices=4 if ndev != 4 else 2)
+
+    srv = EngineServer(port=0, host="127.0.0.1", engine=eng)
+    srv.start_background()
+    try:
+        cli = RemoteEngine(f"127.0.0.1:{srv.port}")
+        with pytest.raises(GeometryRefused, match="geometry"):
+            cli.restore_run(os.path.basename(path))
+        assert cli.restore_run(os.path.basename(path),
+                               reshard=True) == 0
+        snap, t = cli.get_world()
+        np.testing.assert_array_equal((snap != 0).astype(np.uint8),
+                                      seed01)
+    finally:
+        srv.shutdown()

@@ -1,0 +1,191 @@
+"""migrate-smoke: prove zero-downtime live migration end to end on CPU.
+
+One acceptance scenario (PR 15), real member processes behind a real
+in-process router:
+
+  * three `--fleet --federate` servers register with a
+    FederationRouter; one of them spawns with a one-shot
+    `GOL_CHAOS=migrate_fail=redirect` armed in its own environment;
+    runs created THROUGH the router are HRW-placed and parked at a
+    target turn;
+  * a clean `Rescale` live-migrates one run between the two clean
+    members: the reply must report status ok, the router placement
+    must flip to the target, the run must stay readable through the
+    SAME router address at the SAME turn, bit-identical to a device
+    torus replay of its seed — and a straggler call landing directly
+    on the retired source must get the RETRYABLE "moved:" answer,
+    never "unknown run";
+  * the chaos member's FIRST Rescale must fail at the redirect
+    boundary and roll back: the run stays listed on its source at its
+    turn, board intact — and a SECOND Rescale of the SAME run must
+    then succeed (rollback leaves the run fully re-migratable).
+
+Exit 0 = pass.
+
+    make migrate-smoke   # bench.py --migrate + gate, then this
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from federation_smoke import (  # noqa: E402  (tools-local import)
+    FED_ENV, expected_board01, fail, spawn_member, wait_live,
+    wait_member, wait_runs_at)
+
+
+def _raw_call(addr: str, header: dict) -> dict:
+    """One raw wire round trip — NO client retry layer, so a "moved:"
+    answer surfaces instead of being transparently followed."""
+    from gol_tpu import wire
+
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=10.0) as s:
+        wire.enable_nodelay(s)
+        s.settimeout(10.0)
+        wire.send_msg(s, header)
+        resp, _ = wire.recv_msg(s)
+    return resp
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    for var in ("GOL_CHAOS", "GOL_MIGRATE_DEADLINE",
+                "GOL_MIGRATE_STALE"):
+        os.environ.pop(var, None)
+    os.environ.update(FED_ENV)
+
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.federation.router import FederationRouter
+
+    tmpdir = tempfile.mkdtemp(prefix="gol_mig_smoke_")
+    ckpt_root = os.path.join(tmpdir, "ck")
+    target = 16
+    mig_env = {"GOL_MIGRATE_DEADLINE": "120"}
+
+    router = FederationRouter(port=0).start_background()
+    procs = [spawn_member(tmpdir, ckpt_root, router.port,
+                          extra_env=mig_env) for _ in range(2)]
+    procs.append(spawn_member(
+        tmpdir, ckpt_root, router.port,
+        extra_env={**mig_env, "GOL_CHAOS": "migrate_fail=redirect"}))
+    try:
+        addrs = []
+        for p in procs:
+            addr = wait_member(p)
+            if addr is None:
+                return fail("a member never announced its port")
+            addrs.append(addr)
+        chaos_addr = addrs[-1]
+        clean = addrs[:-1]
+        if not wait_live(router, 3):
+            return fail("registry never reached 3 live members")
+        print(f"migrate-smoke: 3 members live behind router "
+              f":{router.port} (migrate_fail=redirect armed on "
+              f"{chaos_addr})", flush=True)
+
+        cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=60.0)
+        rng = np.random.default_rng(7)
+        seeds = {}
+        owners = {}
+        # HRW owns placement; top up until the chaos member and at
+        # least one clean member own a run each.
+        for _ in range(8):
+            rid = f"mig{len(seeds)}"
+            seeds[rid] = (rng.random((64, 64)) < 0.3).astype(np.uint8)
+            cli.create_run(64, 64, board=seeds[rid], run_id=rid,
+                           ckpt_every=4, target_turn=target)
+            owners = wait_runs_at(cli, sorted(seeds), target)
+            if owners is None:
+                return fail("runs never parked at their target turn")
+            if (any(m == chaos_addr for m in owners.values())
+                    and any(m in clean for m in owners.values())):
+                break
+        else:
+            return fail("HRW never covered both member kinds")
+
+        # ---- clean cutover ------------------------------------------
+        rid = next(r for r in sorted(owners) if owners[r] in clean)
+        src = owners[rid]
+        dst = next(a for a in clean if a != src)
+        rec = cli.rescale(rid, dst)
+        if rec.get("status") != "ok" or rec.get("turn") != target:
+            return fail(f"clean Rescale answered {rec}")
+        runs, _ = cli.list_runs()
+        now = {r["run_id"]: r for r in runs}[rid]
+        if now["member"] != dst or now["turn"] != target:
+            return fail(f"{rid} not authoritative on {dst} after the "
+                        f"cutover: {now}")
+        board, turn = cli.for_run(rid).get_world()
+        if turn != target or not np.array_equal(
+                (board != 0).astype(np.uint8),
+                expected_board01(seeds[rid], target)):
+            return fail(f"{rid} diverged from the device replay "
+                        "oracle after the cutover")
+        straggler = _raw_call(src, {"method": "Stats", "run_id": rid})
+        if not str(straggler.get("error", "")).startswith("moved:"):
+            return fail("retired source answered a straggler with "
+                        f"{straggler!r}, wanted a retryable 'moved:'")
+        print(f"migrate-smoke: {rid} cut over {src} -> {dst} "
+              f"(downtime {rec['downtime_ms']} ms), oracle parity "
+              "holds, straggler got moved:", flush=True)
+
+        # ---- chaos rollback, then re-migrate ------------------------
+        crid = next(r for r in sorted(owners)
+                    if owners[r] == chaos_addr)
+        cdst = clean[0]
+        try:
+            cli.rescale(crid, cdst)
+            return fail("the armed migrate_fail=redirect Rescale "
+                        "reported success")
+        except RuntimeError as e:
+            if "rolled back" not in str(e):
+                return fail(f"armed Rescale failed oddly: {e}")
+        runs, _ = cli.list_runs()
+        now = {r["run_id"]: r for r in runs}.get(crid)
+        if now is None or now["member"] != chaos_addr \
+                or now["turn"] != target:
+            return fail(f"rollback did not leave {crid} intact on "
+                        f"{chaos_addr}: {now}")
+        board, turn = cli.for_run(crid).get_world()
+        if turn != target or not np.array_equal(
+                (board != 0).astype(np.uint8),
+                expected_board01(seeds[crid], target)):
+            return fail(f"{crid} board corrupted by the rollback")
+        rec = cli.rescale(crid, cdst)   # the one-shot is spent
+        if rec.get("status") != "ok":
+            return fail(f"post-rollback Rescale answered {rec}")
+        runs, _ = cli.list_runs()
+        now = {r["run_id"]: r for r in runs}[crid]
+        if now["member"] != cdst or now["turn"] != target:
+            return fail(f"{crid} not on {cdst} after the "
+                        f"post-rollback cutover: {now}")
+        print(f"migrate-smoke: {crid} rolled back at redirect, "
+              f"stayed intact on {chaos_addr}, then cut over clean "
+              f"to {cdst}", flush=True)
+        print("migrate-smoke: PASS", flush=True)
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(10)
+        router.shutdown()
+
+
+if __name__ == "__main__":
+    rc = main()
+    # os._exit dodges the known XLA daemon-thread teardown abort;
+    # every gate already flushed its verdict.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

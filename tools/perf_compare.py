@@ -64,7 +64,8 @@ DEFAULT_GATE_PATTERN = (
     r"cell-updates|turns/sec|cups|snapshot MB/s|chunk_overhead_us"
     r"|rpc p\d+ ms|efficiency_pct|fleet_scaling_efficiency_pct"
     r"|overlap_pct|availability_pct|retries_per_call"
-    r"|downtime_p\d+_ms|router_overhead_p\d+_ms"
+    r"|downtime_p\d+_ms|migration_downtime_p\d+_ms"
+    r"|router_overhead_p\d+_ms"
     r"|halo (?:bytes|exchanges)/turn"
     r"|encode_calls_per_published_frame|viewer_fanout_p\d+_ms")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
