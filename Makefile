@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -155,8 +155,24 @@ migrate-smoke:
 fuse-smoke:
 	JAX_PLATFORMS=cpu python tools/fuse_smoke.py
 
+# Fleet telemetry-plane check, CPU-only: bench.py --fleet-obs runs two
+# sequential 3-member federated fleets (heartbeat snapshots on vs off)
+# under the same routed Stats window plus one SIGKILL; the
+# telemetry_overhead_pct / heartbeat_payload_p99_bytes /
+# alert_detection_p99_ms ceilings gate via BASELINE.json.
+# tools/fleet_obs_smoke.py then proves the plane end to end: exact
+# fed_agg rollups, in-budget snapshot payloads, GetTelemetry/GetAudit
+# over the wire, a headless fleet_top frame, and SIGKILL ->
+# member-death alert + gol-fleet-audit/1 records on disk.
+fleet-obs-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --fleet-obs \
+		| tee out/fleet_obs_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/fleet_obs_smoke.jsonl
+	JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -2382,6 +2382,201 @@ def bench_federation(members: int = FED_MEMBERS, runs: int = FED_RUNS,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+FLEET_OBS_MEMBERS = 3
+FLEET_OBS_RUNS = 5
+FLEET_OBS_BOARD = 64
+FLEET_OBS_WINDOW_S = 3.0
+FLEET_OBS_DETECT_CEILING_MS = 5000.0
+
+
+def bench_fleet_obs(members: int = FLEET_OBS_MEMBERS,
+                    runs: int = FLEET_OBS_RUNS,
+                    n: int = FLEET_OBS_BOARD,
+                    window_s: float = FLEET_OBS_WINDOW_S) -> int:
+    """Fleet telemetry-plane leg (PR 16): the cost and the reflexes of
+    the observability path itself. One fleet of `members` real
+    `--fleet --federate` processes behind an in-process router with
+    heartbeat telemetry snapshots on, `runs` live boards stepping,
+    routed Stats traffic in the window. Emits three GATED lines:
+    telemetry_overhead_pct (ceiling -- wall time the router spends
+    inside the plane's ingest + rollup-sweep path, instrumented
+    in-process, as a percentage of the measurement window; a direct
+    cost measure of the registry-tier machinery, so it cannot flap
+    with host contention the way a differential wall-clock between
+    two fleets does), heartbeat_payload_p99_bytes (ceiling -- p99
+    encoded snapshot size the registry ingested; always <= the
+    GOL_FED_SNAPSHOT_MAX budget by construction, the gate catches a
+    fattening schema), and alert_detection_p99_ms (ceiling -- SIGKILL
+    a member to first member-death alert FIRING on the router;
+    detection rides GOL_FED_DEAD_AFTER + one sweep). Hard-fails
+    independently of the perf gate when the rollup is not the exact
+    per-member sum, when any ingested payload exceeded the budget, or
+    when the alert never fires inside the ceiling."""
+    import os
+    import shutil
+    import signal
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import federation_smoke as fed
+
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.federation.router import FederationRouter
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.obs.export import snapshot_budget
+
+    for var in ("GOL_CHAOS", "GOL_RPC_RETRIES", "GOL_RULE",
+                "GOL_CKPT", "GOL_CKPT_EVERY_TURNS",
+                "GOL_FED_SNAPSHOT_MAX"):
+        os.environ.pop(var, None)
+    os.environ.update(fed.FED_ENV)
+    rc = 0
+    tmpdir = tempfile.mkdtemp(prefix="gol_fleet_obs_bench_")
+    ckpt_root = os.path.join(tmpdir, "ck")
+    router = FederationRouter(
+        port=0, audit_dir=os.path.join(tmpdir, "audit")
+    ).start_background()
+    procs = [fed.spawn_member(tmpdir, ckpt_root, router.port)
+             for _ in range(members)]
+    try:
+        addrs = [fed.wait_member(p) for p in procs]
+        if None in addrs or not fed.wait_live(router, members):
+            print("BENCH LEG FAILED (fleet-obs): members never came "
+                  "up", file=sys.stderr)
+            return 1
+        cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=60.0)
+        rng = np.random.default_rng(16)
+        ids = []
+        for i in range(runs):
+            rid = f"obs{i}"
+            cli.create_run(
+                n, n,
+                board=(rng.random((n, n)) < 0.3).astype(np.uint8),
+                run_id=rid, ckpt_every=4)
+            ids.append(rid)
+        # No target turn: parked runs leave the resident state and
+        # this leg pins the resident-sum rollup.
+        owners = fed.wait_runs_at(cli, ids, 4)
+        if owners is None:
+            print("BENCH LEG FAILED (fleet-obs): runs never started "
+                  "stepping", file=sys.stderr)
+            return 1
+        bound = {rid: cli.for_run(rid) for rid in ids}
+
+        # Instrument the plane's two router-side entry points: every
+        # heartbeat ingest and every rollup sweep adds its wall time
+        # to the accumulator. The sweeper and acceptor threads call
+        # these concurrently with this thread's routed traffic, which
+        # is exactly the contention the cost measure should include.
+        tele = router.telemetry
+        plane_s = {"v": 0.0}
+        orig_ingest, orig_sweep = tele.ingest, tele.sweep
+
+        def timed(fn):
+            def wrapper(*a, **kw):
+                t0 = time.perf_counter()
+                try:
+                    return fn(*a, **kw)
+                finally:
+                    plane_s["v"] += time.perf_counter() - t0
+            return wrapper
+
+        tele.ingest, tele.sweep = timed(orig_ingest), timed(orig_sweep)
+        plane_s["v"] = 0.0
+        routed_calls = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < window_s:
+            for rid in ids:
+                try:
+                    bound[rid].stats()
+                    routed_calls += 1
+                except Exception:
+                    pass
+        wall_s = time.perf_counter() - t0
+        overhead_pct = plane_s["v"] / wall_s * 100.0
+        tele.ingest, tele.sweep = orig_ingest, orig_sweep
+
+        # Rollup exactness after at least one post-window sweep.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            fleet = tele.doc().get("fleet", {})
+            if fleet.get("runs_resident") == runs \
+                    and fleet.get("members_reporting") == members:
+                break
+            time.sleep(0.2)
+        doc = tele.doc()
+        fleet = doc.get("fleet", {})
+        member_sum = sum(r["resident"] for r in
+                         doc.get("members", {}).values())
+        if fleet.get("runs_resident") != member_sum \
+                or fleet.get("runs_resident") != runs:
+            print("BENCH LEG FAILED (fleet-obs): rollup "
+                  f"{fleet.get('runs_resident')} != member sum "
+                  f"{member_sum} / {runs} created runs",
+                  file=sys.stderr)
+            return 1
+        budget = snapshot_budget()
+        p99_bytes = obs_cat.FED_AGG_PAYLOAD_BYTES.labels(q="p99").value
+        payload_samples = tele._payload.count
+
+        # SIGKILL the member owning run 0; detection = first sweep
+        # that sees the death verdict fires member-death (for_s=0).
+        victim = owners[ids[0]]
+        vic_proc = procs[addrs.index(victim)]
+        os.kill(vic_proc.pid, signal.SIGKILL)
+        t_kill = time.perf_counter()
+        vic_proc.wait(10)
+        detect_ms = None
+        while time.perf_counter() - t_kill \
+                < FLEET_OBS_DETECT_CEILING_MS / 1e3:
+            if "member-death" in tele.alerts.active():
+                detect_ms = (time.perf_counter() - t_kill) * 1e3
+                break
+            time.sleep(0.01)
+
+        detail = {
+            "members": members, "runs": runs, "size": n,
+            "window_s": round(wall_s, 3),
+            "snapshot_budget_bytes": budget,
+            "routed_calls": routed_calls,
+            "plane_wall_s": round(plane_s["v"], 6),
+            "payload_samples": payload_samples,
+            "victim": victim, "detect_samples": 1,
+            "fed_env": dict(fed.FED_ENV),
+            "method": "router-side ingest + sweep wall time "
+                      "(in-process instrumentation) over the routed "
+                      "Stats window; payload p99 is the router-side "
+                      "ingest estimator; detection is SIGKILL to the "
+                      "member-death rule FIRING on the router sweep",
+        }
+        _emit("telemetry_overhead_pct (fleet-obs, registry tier)",
+              round(overhead_pct, 3), "%", None, detail)
+        _emit("heartbeat_payload_p99_bytes (fleet-obs)",
+              round(p99_bytes or 0.0, 1), "bytes", None, detail)
+        _emit("alert_detection_p99_ms (fleet-obs, SIGKILL member)",
+              round(detect_ms, 1) if detect_ms is not None else -1.0,
+              "ms", None, detail)
+        if not p99_bytes or p99_bytes > budget:
+            print(f"BENCH LEG FAILED (fleet-obs): ingested payload "
+                  f"p99 {p99_bytes} outside (0, {budget}]",
+                  file=sys.stderr)
+            rc |= 1
+        if detect_ms is None:
+            print("BENCH LEG FAILED (fleet-obs): member-death alert "
+                  f"never fired within {FLEET_OBS_DETECT_CEILING_MS} "
+                  "ms", file=sys.stderr)
+            rc |= 1
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(10)
+        router.shutdown()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 MIG_MEMBERS = 3           # two clean members + one migrate_fail-armed
 MIG_RUNS = 8              # initial seeds; topped up until HRW covers
 MIG_BOARD = 64
@@ -2872,6 +3067,14 @@ def main() -> int:
                          "(emits the gated availability_pct / "
                          "failover_downtime_p99_ms / "
                          "router_overhead_p99_ms lines)")
+    ap.add_argument("--fleet-obs", action="store_true",
+                    help="run the fleet telemetry-plane leg only: "
+                         "two sequential 3-member federated fleets "
+                         "(heartbeat snapshots on vs off) under the "
+                         "same routed Stats window, one SIGKILL "
+                         "(emits the gated telemetry_overhead_pct / "
+                         "heartbeat_payload_p99_bytes / "
+                         "alert_detection_p99_ms lines)")
     ap.add_argument("--migrate", action="store_true",
                     help="run the live-migration leg only: 3 --fleet "
                          "--federate member processes behind an "
@@ -3020,6 +3223,16 @@ def _dispatch(args, ap) -> int:
             ap.error("--migrate is its own config; it takes no "
                      "other leg flags")
         return bench_migrate()
+
+    if args.fleet_obs:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.chaos or args.fleet or args.load \
+                or args.mesh or args.size is not None \
+                or args.turns is not None:
+            ap.error("--fleet-obs is its own config; it takes no "
+                     "other leg flags")
+        return bench_fleet_obs()
 
     if args.fuse:
         if args.pattern != "dense" or args.gen or args.engine \

@@ -24,7 +24,7 @@ import socket
 import threading
 import time
 import uuid
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -433,6 +433,35 @@ class RemoteEngine:
         resp, _ = self._call({"method": "GetMetrics"},
                              timeout=self._timeout)
         return dict(resp["metrics"])
+
+    def get_telemetry(self, series: Optional[str] = None,
+                      tier: str = "raw", since: float = 0.0,
+                      labels: Optional[dict] = None) -> dict:
+        """The peer's telemetry document. Against a federation router
+        this is the fleet view (rollups, per-member table, alerts,
+        tsdb summary; `series` adds one tsdb series' merged buckets);
+        against a member it is that member's own family values."""
+        header: dict = {"method": "GetTelemetry"}
+        if series:
+            header["series"] = series
+            header["tier"] = tier
+            if since:
+                header["since"] = float(since)
+            if labels:
+                header["labels"] = dict(labels)
+        resp, _ = self._call(header, timeout=self._timeout)
+        return dict(resp["telemetry"])
+
+    def get_audit(self, since_seq: int = 0,
+                  limit: int = 100) -> list:
+        """gol-fleet-audit/1 records with seq > since_seq, oldest
+        first (the router's durable log; a member answers from its
+        local event ring)."""
+        resp, _ = self._call(
+            {"method": "GetAudit", "since_seq": int(since_seq),
+             "limit": int(limit)},
+            timeout=self._timeout)
+        return list(resp.get("records", []))
 
     def abort_run(self) -> bool:
         """Stop the engine's current run IF it is this controller's own

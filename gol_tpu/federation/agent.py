@@ -22,6 +22,7 @@ from typing import Optional
 
 from gol_tpu import wire
 from gol_tpu.federation.registry import heartbeat_interval_s
+from gol_tpu.obs.export import SnapshotExporter
 from gol_tpu.obs.log import log as obs_log
 
 
@@ -38,6 +39,7 @@ class FederationAgent:
         self.mesh = mesh
         self._timeout = float(timeout)
         self._seq = 0
+        self._exporter = SnapshotExporter()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -55,12 +57,26 @@ class FederationAgent:
         if self.mesh is not None:
             header["mesh"] = self.mesh
         try:
+            # Telemetry rides the beat we already pay for (obs/export);
+            # a snapshot failure must never cost us the heartbeat.
+            snap = self._exporter.build()
+            if snap is not None:
+                header["snap"] = snap
+        except Exception as e:  # noqa: BLE001 — beat > snapshot
+            obs_log("fed.snapshot_failed", level="warning",
+                    member=self.address,
+                    error=f"{type(e).__name__}: {e}")
+        try:
             with socket.create_connection(
                     self._router, timeout=self._timeout) as sock:
                 sock.settimeout(self._timeout)
                 wire.enable_nodelay(sock)
                 wire.send_msg(sock, header)
                 resp, _ = wire.recv_msg(sock)
+            try:
+                self._exporter.commit(resp)
+            except Exception:  # noqa: BLE001
+                pass
             return resp
         except (OSError, ConnectionError, wire.WireProtocolError) as e:
             obs_log("fed.heartbeat_failed", level="warning",

@@ -100,7 +100,8 @@ class MemberRegistry:
         now = time.monotonic()
         with self._lock:
             m = self._members.get(member_id)
-            if m is None:
+            joined = m is None
+            if joined:
                 m = Member(member_id, address, capacity=capacity,
                            mesh=mesh)
                 self._members[member_id] = m
@@ -123,7 +124,7 @@ class MemberRegistry:
                        if x.state == "live")
         self._publish()
         return {"registered": True, "accepted": accepted,
-                "rejoined": was_dead, "live": live}
+                "joined": joined, "rejoined": was_dead, "live": live}
 
     # -- queries ------------------------------------------------------
 

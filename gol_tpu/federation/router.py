@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import argparse
 import collections
+import os
 import socket
 import threading
 import time
@@ -56,10 +57,14 @@ from gol_tpu import wire
 from gol_tpu.federation import hrw
 from gol_tpu.federation import registry as registry_mod
 from gol_tpu.federation.registry import Member, MemberRegistry
+from gol_tpu.obs import audit as obs_audit
 from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import export as obs_export
 from gol_tpu.obs import slo as obs_slo
 from gol_tpu.obs.log import log as obs_log
 from gol_tpu.utils.envcfg import env_float
+
+AUDIT_DIR_ENV = "GOL_AUDIT_DIR"
 
 REROUTE_ENV = "GOL_FED_REROUTE"
 REROUTE_DEFAULT_S = 10.0
@@ -94,9 +99,18 @@ class FederationRouter:
     """One router process (or in-process instance, for tests/bench)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: Optional[MemberRegistry] = None) -> None:
+                 registry: Optional[MemberRegistry] = None,
+                 audit_dir: Optional[str] = None) -> None:
         self.host = host
         self.registry = registry or MemberRegistry()
+        # Fleet telemetry plane (PR 16): durable audit log (memory-only
+        # unless GOL_AUDIT_DIR / audit_dir names a directory), bounded
+        # tsdb + rollups + alerting fed by heartbeat snapshots.
+        if audit_dir is None:
+            audit_dir = os.environ.get(AUDIT_DIR_ENV) or None
+        self.audit_log = obs_audit.AuditLog(path=audit_dir)
+        self.telemetry = obs_export.FleetTelemetry(
+            audit_log=self.audit_log)
         # run_id -> {"member", "ckpt_every", "target_turn"}
         self._placements: Dict[str, dict] = {}
         self._plock = threading.Lock()
@@ -112,6 +126,7 @@ class FederationRouter:
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         registry_mod.set_active(self.registry)
+        obs_export.set_active_telemetry(self.telemetry)
 
     # -- lifecycle ----------------------------------------------------
 
@@ -132,6 +147,8 @@ class FederationRouter:
         for t in self._threads:
             t.join(timeout=2.0)
         registry_mod.set_active(None)
+        obs_export.set_active_telemetry(None)
+        self.audit_log.close()
 
     # -- accept / dispatch --------------------------------------------
 
@@ -159,13 +176,27 @@ class FederationRouter:
             payload = wire._recv_exact(conn, n) if n else b""
             method = str(header.get("method", ""))
             if method == "RegisterMember":
+                mid = str(header.get("member_id", ""))
                 ack = self.registry.register(
-                    str(header.get("member_id", "")),
+                    mid,
                     str(header.get("address", "")),
                     int(header.get("seq", 0)),
                     capacity=int(header.get("capacity", 0)),
                     mesh=header.get("mesh"))
+                if ack.get("joined"):
+                    self.audit_log.append("member_join", member=mid)
+                elif ack.get("rejoined"):
+                    self.audit_log.append("member_rejoin", member=mid)
+                # Heartbeat-borne telemetry: ingest may mark the ack
+                # with snap_resync when a delta finds no base state.
+                self.telemetry.ingest(mid, header.get("snap"), ack)
                 wire.send_msg(conn, ack)
+                return
+            if method == "GetTelemetry":
+                wire.send_msg(conn, self._get_telemetry(header))
+                return
+            if method == "GetAudit":
+                wire.send_msg(conn, self._get_audit(header))
                 return
             if method == "ListRuns":
                 wire.send_msg(conn, self._list_runs(header))
@@ -407,6 +438,34 @@ class FederationRouter:
         obs_log("fed.pinned", run_id=rid, member=mid, prev=prev)
         return {"ok": True, "run_id": rid, "member": mid, "prev": prev}
 
+    # -- telemetry / audit queries (served locally) --------------------
+
+    def _get_telemetry(self, header: dict) -> dict:
+        """The fleet telemetry doc; an optional `series` key adds one
+        tsdb series' merged buckets (`tier`, `since`, `labels`)."""
+        doc = dict(self.telemetry.doc())
+        name = header.get("series")
+        if name:
+            doc["series"] = {
+                "name": str(name),
+                "tier": str(header.get("tier", "raw")),
+                "points": self.telemetry.query(
+                    str(name),
+                    labels=header.get("labels") or (),
+                    tier=str(header.get("tier", "raw")),
+                    since=float(header.get("since", 0.0) or 0.0)),
+            }
+        return {"ok": True, "telemetry": doc}
+
+    def _get_audit(self, header: dict) -> dict:
+        try:
+            since_seq = int(header.get("since_seq", 0) or 0)
+            limit = int(header.get("limit", 100) or 100)
+        except (TypeError, ValueError):
+            return {"error": "GetAudit: since_seq/limit must be ints"}
+        return {"ok": True, "seq": self.audit_log.seq,
+                "records": self.audit_log.tail(since_seq, limit)}
+
     def _record_placement(self, rid: str, header: dict,
                           member_id: str) -> None:
         tt = header.get("target_turn")
@@ -450,7 +509,14 @@ class FederationRouter:
         for member in self.registry.sweep():
             obs_log("fed.member_dead", level="error",
                     member=member.member_id)
+            self.audit_log.append("member_death",
+                                  member=member.member_id,
+                                  address=member.address)
             self._adopt_runs_of(member)
+        # Rollups + alert evaluation ride the same sweep cadence the
+        # death verdicts do — alert detection latency is bounded by
+        # GOL_FED_DEAD_AFTER plus half a heartbeat, nothing more.
+        self.telemetry.sweep(self.registry.members_doc())
         self._flush_overhead()
 
     def _flush_overhead(self) -> None:
@@ -503,6 +569,7 @@ class FederationRouter:
                 self._placements[rid]["member"] = mid
             obs_log("fed.adopted", run_id=rid, member=mid,
                     state=resp.get("run", {}).get("state"))
+            self.audit_log.append("adopt", run_id=rid, member=mid)
             return
         obs.FED_ADOPTED_RUNS.labels(status="error").inc()
         obs_log("fed.adopt_failed", level="error", run_id=rid)
@@ -523,9 +590,14 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="serve /metrics + /healthz (0 = ephemeral)")
+    ap.add_argument("--audit-dir", default=None,
+                    help="directory for the durable gol-fleet-audit/1 "
+                         "JSONL log (default $GOL_AUDIT_DIR, else "
+                         "memory-only)")
     args = ap.parse_args(argv)
 
-    router = FederationRouter(port=args.port, host=args.host)
+    router = FederationRouter(port=args.port, host=args.host,
+                              audit_dir=args.audit_dir)
     if args.metrics_port is not None:
         from gol_tpu.obs.http import start_metrics_server
         msrv = start_metrics_server(args.metrics_port)

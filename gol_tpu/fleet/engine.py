@@ -73,6 +73,7 @@ from gol_tpu.fleet.handles import (
     valid_run_id,
 )
 from gol_tpu.models.lifelike import CONWAY, LifeLikeRule
+from gol_tpu.obs import audit as obs_audit
 from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import devstats as obs_devstats
 from gol_tpu.obs import slo as obs_slo
@@ -1780,6 +1781,10 @@ class FleetEngine(ControlFlagProtocol):
         obs.RUNS_QUARANTINED.labels(reason=reason).inc()
         obs_log("fleet.quarantine", level="error", run_id=h.run_id,
                 reason=reason, turn=h.turn)
+        # Fleet audit (PR 16): rides the next heartbeat snapshot into
+        # the registry tier's durable gol-fleet-audit/1 log.
+        obs_audit.note("quarantine", run_id=h.run_id, reason=reason,
+                       turn=h.turn)
         # NOTE: h.done is NOT set — a driven run stays driven; the
         # restore path re-queues it and the drive completes normally.
         # Only exhausted restores (below) release waiting drivers.

@@ -57,6 +57,7 @@ from typing import Optional
 import numpy as np
 
 from gol_tpu import chaos, wire
+from gol_tpu.obs import audit as obs_audit
 from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import trace
 from gol_tpu.obs.log import exception as obs_exception
@@ -132,6 +133,13 @@ def _chaos_gate(phase: str) -> None:
         raise RuntimeError(f"chaos: migrate_fail at phase {phase!r}")
 
 
+def _audit_phase(rid: str, target: str, phase: str) -> None:
+    """One fleet-audit event per migration phase (PR 16) — queued for
+    the next heartbeat snapshot, so phase history lands in the registry
+    tier's durable gol-fleet-audit/1 log."""
+    obs_audit.note("migrate", run_id=rid, target=target, phase=phase)
+
+
 def rescale(server, run_id: str, target: str) -> dict:
     """Coordinate one live migration of `run_id` from `server`'s engine
     to the member advertised at `target` ("host:port" — also its
@@ -187,14 +195,17 @@ def rescale(server, run_id: str, target: str) -> dict:
             # -- quiesce ------------------------------------------------
             with trace.span("migrate.quiesce"):
                 _chaos_gate("quiesce")
+                _audit_phase(rid, target, "quiesce")
                 quiesced = engine.migrate_quiesce(rid)
             # -- checkpoint ---------------------------------------------
             with trace.span("migrate.checkpoint"):
                 _chaos_gate("checkpoint")
+                _audit_phase(rid, target, "checkpoint")
                 engine.migrate_checkpoint(rid)
             # -- transfer -----------------------------------------------
             with trace.span("migrate.transfer"):
                 _chaos_gate("transfer")
+                _audit_phase(rid, target, "transfer")
                 px = (quiesced["board"] *
                       np.uint8(255)).astype(np.uint8)
                 frame = wire.encode_board(
@@ -216,12 +227,14 @@ def rescale(server, run_id: str, target: str) -> dict:
             t_cut = time.monotonic()
             with trace.span("migrate.resume"):
                 _chaos_gate("resume")
+                _audit_phase(rid, target, "resume")
                 _rpc(target, {"method": "CommitRun", "run_id": rid,
                               "req_id": f"mig-{rid}-{nonce}-commit"},
                      timeout=remaining())
             # -- redirect -----------------------------------------------
             with trace.span("migrate.redirect"):
                 _chaos_gate("redirect")
+                _audit_phase(rid, target, "redirect")
                 # Stragglers relayed to us before the pin flips get a
                 # RETRYABLE "moved:" answer once our copy retires —
                 # registered before anything can observe the removal.
@@ -256,6 +269,7 @@ def rescale(server, run_id: str, target: str) -> dict:
             _publish_downtime(downtime_s)
             root.attrs["downtime_ms"] = round(downtime_s * 1e3, 3)
         obs.MIGRATIONS.labels(status="ok").inc()
+        _audit_phase(rid, target, "ok")
         obs_log("migrate.ok", run_id=rid, target=target,
                 turn=quiesced["turn"],
                 downtime_ms=round(downtime_s * 1e3, 3),
@@ -312,6 +326,7 @@ def _rollback(engine, rid: str, target: str, staged_on_target: bool,
         status = "error"
         obs_exception("migrate.rollback_failed", e, run_id=rid)
     obs.MIGRATIONS.labels(status=status).inc()
+    _audit_phase(rid, target, status)
     obs_log("migrate.rolled_back", level="warning", run_id=rid,
             target=target, status=status,
             cause=f"{type(cause).__name__}: {cause}")

@@ -21,6 +21,7 @@ WIRE_METHODS = (
     "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "SetRule",
     "RegisterMember", "AdoptRun", "Subscribe",
     "Rescale", "ReceiveRun", "CommitRun", "PinRun",
+    "GetTelemetry", "GetAudit",
     "unknown",
 )
 
@@ -462,6 +463,138 @@ FED_ROUTER_OVERHEAD_MS = REGISTRY.gauge(
     label_names=("q",))
 for _q in SLO_QUANTILES:
     FED_ROUTER_OVERHEAD_MS.labels(q=_q)
+
+
+# ----------------------------------------------- fleet telemetry plane
+
+# Heartbeat-borne snapshot export (obs/export.py, member side). The
+# drop family labels mirror export.FAMILY_LABELS plus "events" — a
+# closed set, so an over-budget snapshot meters exactly what it shed.
+SNAPSHOT_FAMILIES = ("resident", "queue", "staleness", "quantum",
+                     "slo", "cups", "dev_bytes", "events", "unknown")
+
+FED_SNAPSHOT_BYTES = REGISTRY.gauge(
+    "gol_fed_snapshot_bytes",
+    "Encoded size in bytes of the most recent telemetry snapshot this "
+    "member attached to its RegisterMember heartbeat; always <= "
+    "GOL_FED_SNAPSHOT_MAX (over-budget snapshots degrade by dropping "
+    "families, never by fattening the beat).")
+FED_SNAPSHOT_TOTAL = REGISTRY.counter(
+    "gol_fed_snapshot_total",
+    "Telemetry snapshots built for heartbeat export, by kind: full "
+    "(first beat, or after the router requested a resync) or delta "
+    "(only families whose value changed since the last ACKED beat).",
+    label_names=("kind",))
+for _k in ("full", "delta"):
+    FED_SNAPSHOT_TOTAL.labels(kind=_k)
+FED_SNAPSHOT_DROPPED = REGISTRY.counter(
+    "gol_fed_snapshot_dropped_total",
+    "Snapshot families (or the event batch) dropped to fit the "
+    "GOL_FED_SNAPSHOT_MAX byte budget, lowest priority first; dropped "
+    "deltas stay uncommitted and re-ship on the next beat.",
+    label_names=("family",))
+for _f in SNAPSHOT_FAMILIES:
+    FED_SNAPSHOT_DROPPED.labels(family=_f)
+FED_SNAPSHOT_INGESTED = REGISTRY.counter(
+    "gol_fed_snapshot_ingested_total",
+    "Heartbeat telemetry snapshots the registry tier merged into its "
+    "per-member state (router side).")
+
+# Fleet rollups the registry tier re-publishes every sweep from the
+# ingested member snapshots (router side) — the sensing inputs of the
+# ROADMAP item-3 control loop.
+FED_AGG_RUNS_RESIDENT = REGISTRY.gauge(
+    "gol_fed_agg_runs_resident",
+    "Fleet-wide resident-run total: exact sum of gol_runs_resident "
+    "reported by live members at the router's last telemetry sweep.")
+FED_AGG_QUEUE_DEPTH = REGISTRY.gauge(
+    "gol_fed_agg_queue_depth",
+    "Fleet-wide admission-queue depth: sum of gol_fleet_queue_depth "
+    "across live members at the last telemetry sweep.")
+FED_AGG_CUPS = REGISTRY.gauge(
+    "gol_fed_agg_cups",
+    "Aggregate cell updates per second: sum of gol_engine_cups across "
+    "live members at the last telemetry sweep.")
+FED_AGG_STALENESS_MS = REGISTRY.gauge(
+    "gol_fed_agg_staleness_ms",
+    "Fleet staleness quantiles in milliseconds: per-quantile MAX of "
+    "gol_fleet_staleness_ms across live members (the worst member "
+    "bounds the fleet).",
+    label_names=("q",))
+for _q in SLO_QUANTILES:
+    FED_AGG_STALENESS_MS.labels(q=_q)
+FED_AGG_IMBALANCE = REGISTRY.gauge(
+    "gol_fed_agg_imbalance_ratio",
+    "max/mean of per-member resident-run counts across reporting live "
+    "members (1.0 = balanced or fewer than one resident anywhere).")
+FED_AGG_MEMBERS_REPORTING = REGISTRY.gauge(
+    "gol_fed_agg_members_reporting",
+    "Live members whose telemetry snapshot the registry has ingested "
+    "(<= gol_fed_members{state='live'}; lags one heartbeat on join).")
+FED_AGG_SLO_BREACHES = REGISTRY.gauge(
+    "gol_fed_agg_slo_breaches_total",
+    "Fleet-wide SLO breach total: sum of each live member's "
+    "gol_slo_breaches_total as last reported (a gauge of summed "
+    "member counters, so member death can lower it).")
+FED_AGG_DEV_LIVE_BYTES = REGISTRY.gauge(
+    "gol_fed_agg_dev_live_bytes",
+    "Fleet-wide live device memory in bytes: sum of per-device "
+    "gol_dev_live_bytes across live members at the last sweep.")
+FED_AGG_PAYLOAD_BYTES = REGISTRY.gauge(
+    "gol_fed_agg_payload_bytes",
+    "Quantiles of ingested heartbeat-snapshot sizes in bytes (router "
+    "side, log-bucket estimator over all members since start).",
+    label_names=("q",))
+for _q in SLO_QUANTILES:
+    FED_AGG_PAYLOAD_BYTES.labels(q=_q)
+
+# Bounded time-series store (obs/tsdb.py, registry tier).
+TSDB_SERIES = REGISTRY.gauge(
+    "gol_tsdb_series",
+    "Distinct series currently resident in the telemetry tsdb rings "
+    "(hard-capped at GOL_TSDB_MAX_SERIES).")
+TSDB_POINTS = REGISTRY.gauge(
+    "gol_tsdb_points_total",
+    "Samples appended to the telemetry tsdb since process start "
+    "(every sample lands in all retention tiers at once).")
+TSDB_EVICTIONS = REGISTRY.gauge(
+    "gol_tsdb_evictions_total",
+    "Series evicted least-recently-appended-first because the tsdb "
+    "hit its GOL_TSDB_MAX_SERIES cardinality cap; a runaway label "
+    "source degrades retention, never memory.")
+
+# Alerting (obs/alerts.py). Built-in rule names are pre-seeded; rules
+# added via GOL_ALERT_RULES seed their children at manager start.
+ALERT_BUILTIN_RULES = ("member-death", "staleness-ceiling",
+                       "queue-depth", "resident-imbalance")
+ALERTS_ACTIVE = REGISTRY.gauge(
+    "gol_alerts_active",
+    "1 while the named alert rule is firing, else 0 (firing requires "
+    "the breach to hold for the rule's for_s; resolving requires "
+    "clear_s continuously below threshold — hysteresis, so a flapping "
+    "signal cannot strobe this gauge).",
+    label_names=("rule",))
+ALERTS_FIRED = REGISTRY.counter(
+    "gol_alerts_fired_total",
+    "firing transitions of the named alert rule (each one also lands "
+    "a flight-recorder event and a fleet audit-log record).",
+    label_names=("rule",))
+for _r in ALERT_BUILTIN_RULES:
+    ALERTS_ACTIVE.labels(rule=_r)
+    ALERTS_FIRED.labels(rule=_r)
+
+# Fleet audit log (obs/audit.py): the gol-fleet-audit/1 record kinds,
+# clamped like every other label set.
+AUDIT_KINDS = ("member_join", "member_rejoin", "member_death", "adopt",
+               "migrate", "quarantine", "alert_fired", "alert_resolved",
+               "other")
+AUDIT_RECORDS = REGISTRY.counter(
+    "gol_audit_records_total",
+    "gol-fleet-audit/1 records appended (durable log on the registry "
+    "tier, bounded in-memory event ring on members), by kind.",
+    label_names=("kind",))
+for _k in AUDIT_KINDS:
+    AUDIT_RECORDS.labels(kind=_k)
 
 
 # ------------------------------------------- live migration & resharding

@@ -71,6 +71,16 @@ def healthz_doc() -> dict:
         fed = None
     if fed is not None:
         doc["federation"] = fed
+    # Fleet telemetry plane (PR 16): the registry tier's aggregated
+    # rollups + alert states + tsdb summary — another reference-swapped
+    # cached document, absent on processes that run no router.
+    try:
+        from gol_tpu.obs import export as obs_export
+        telemetry = obs_export.active_telemetry_doc()
+    except Exception:  # noqa: BLE001 — /healthz must never 500
+        telemetry = None
+    if telemetry:
+        doc["telemetry"] = telemetry
     return doc
 
 

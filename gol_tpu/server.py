@@ -538,6 +538,21 @@ class EngineServer:
                 # — the wire-native face of the /metrics endpoint.
                 self._reply(conn,
                             {"ok": True, "metrics": REGISTRY.snapshot()})
+            elif method == "GetTelemetry":
+                # A member answers with its OWN family values (the
+                # router answers the same method with fleet rollups).
+                from gol_tpu.obs import export as obs_export
+                self._reply(conn, {"ok": True,
+                                   "telemetry": obs_export.local_doc()})
+            elif method == "GetAudit":
+                # Member-local event ring; the durable gol-fleet-audit/1
+                # log lives on the registry tier.
+                from gol_tpu.obs import audit as obs_audit
+                self._reply(conn, {
+                    "ok": True,
+                    "records": obs_audit.recent(
+                        int(header.get("since_seq", 0) or 0),
+                        int(header.get("limit", 100) or 100))})
             elif method == "Alivecount":
                 alive, turn = eng.alive_count()
                 self._reply(conn,

@@ -1,0 +1,147 @@
+"""Alert-hysteresis unit matrix (PR 16): fire, flap-suppress,
+resolve — plus rule parsing and the built-in rule set's gating.
+
+Time is injected (`now=`) so the pending/clear windows are exact;
+no sleeps, no jax.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from gol_tpu.obs import catalog as obs
+from gol_tpu.obs.alerts import (
+    AlertManager, AlertRule, builtin_rules, rules_from_env)
+
+
+def manager(**rule_kw):
+    rule = AlertRule("r", "sig", **rule_kw)
+    events = []
+    m = AlertManager(
+        rules=[rule],
+        on_transition=lambda r, ev, v, now: events.append((ev, v, now)))
+    return m, events
+
+
+# ------------------------------------------------------------- fire
+
+def test_immediate_fire_when_for_s_zero():
+    m, events = manager(op=">", threshold=0.0, for_s=0.0, clear_s=5.0)
+    tr = m.evaluate({"sig": 1.0}, now=10.0)
+    assert tr == [{"rule": "r", "event": "fired", "value": 1.0}]
+    assert events == [("fired", 1.0, 10.0)]
+    assert "r" in m.active()
+
+
+def test_for_s_debounces_a_short_breach():
+    m, events = manager(threshold=5.0, for_s=3.0, clear_s=5.0)
+    assert m.evaluate({"sig": 9.0}, now=0.0) == []   # pending
+    assert m.evaluate({"sig": 9.0}, now=2.0) == []   # still pending
+    assert m.evaluate({"sig": 1.0}, now=2.5) == []   # cleared: reset
+    assert m.evaluate({"sig": 9.0}, now=4.0) == []   # pending again
+    tr = m.evaluate({"sig": 9.0}, now=7.0)           # held for_s: fire
+    assert [t["event"] for t in tr] == ["fired"]
+    assert events[-1][2] == 7.0
+
+
+def test_fired_metrics_move():
+    fired0 = obs.ALERTS_FIRED.labels(rule="r-metrics").value
+    rule = AlertRule("r-metrics", "sig", threshold=0.0, for_s=0.0,
+                     clear_s=0.0)
+    m = AlertManager(rules=[rule])
+    m.evaluate({"sig": 2.0}, now=1.0)
+    assert obs.ALERTS_ACTIVE.labels(rule="r-metrics").value == 1
+    assert obs.ALERTS_FIRED.labels(rule="r-metrics").value == fired0 + 1
+    m.evaluate({"sig": 0.0}, now=2.0)
+    assert obs.ALERTS_ACTIVE.labels(rule="r-metrics").value == 0
+
+
+# ---------------------------------------------------------- resolve
+
+def test_resolve_requires_clear_s_continuously_below():
+    m, events = manager(threshold=0.0, for_s=0.0, clear_s=5.0)
+    m.evaluate({"sig": 1.0}, now=0.0)
+    assert m.evaluate({"sig": 0.0}, now=1.0) == []   # clear window opens
+    assert m.evaluate({"sig": 0.0}, now=4.0) == []   # not yet clear_s
+    tr = m.evaluate({"sig": 0.0}, now=6.5)
+    assert [t["event"] for t in tr] == ["resolved"]
+    assert m.active() == {}
+    assert [e[0] for e in events] == ["fired", "resolved"]
+
+
+def test_flap_suppression_restarts_the_clear_window():
+    m, events = manager(threshold=0.0, for_s=0.0, clear_s=5.0)
+    m.evaluate({"sig": 1.0}, now=0.0)
+    m.evaluate({"sig": 0.0}, now=1.0)    # clear opens at 1
+    m.evaluate({"sig": 1.0}, now=4.0)    # flap: cancels the window
+    m.evaluate({"sig": 0.0}, now=5.0)    # clear re-opens at 5
+    assert m.evaluate({"sig": 0.0}, now=8.0) == []  # 3 s < clear_s
+    tr = m.evaluate({"sig": 0.0}, now=10.5)
+    assert [t["event"] for t in tr] == ["resolved"]
+    # Exactly ONE fired event despite the flap — no strobing.
+    assert [e[0] for e in events] == ["fired", "resolved"]
+
+
+def test_missing_signal_holds_state():
+    """No data is not a resolve: a member dropping the family from its
+    snapshot must not clear an active alert."""
+    m, events = manager(threshold=0.0, for_s=0.0, clear_s=1.0)
+    m.evaluate({"sig": 1.0}, now=0.0)
+    assert m.evaluate({}, now=100.0) == []
+    assert "r" in m.active()
+
+
+# ----------------------------------------------------- rule plumbing
+
+def test_requires_gates_evaluation():
+    rule = AlertRule("imb", "ratio", threshold=2.0, for_s=0.0,
+                     clear_s=0.0, requires=("multi",))
+    m = AlertManager(rules=[rule])
+    assert m.evaluate({"ratio": 9.0, "multi": False}, now=0.0) == []
+    tr = m.evaluate({"ratio": 9.0, "multi": True}, now=1.0)
+    assert [t["event"] for t in tr] == ["fired"]
+
+
+def test_builtin_rules_cover_the_catalog_set():
+    names = {r.name for r in builtin_rules()}
+    assert names == set(obs.ALERT_BUILTIN_RULES)
+
+
+def test_builtin_thresholds_from_env(monkeypatch):
+    monkeypatch.setenv("GOL_ALERT_QUEUE_DEPTH", "7")
+    monkeypatch.setenv("GOL_ALERT_STALENESS_MS", "1234")
+    rules = {r.name: r for r in builtin_rules()}
+    assert rules["queue-depth"].threshold == 7.0
+    assert rules["staleness-ceiling"].threshold == 1234.0
+    assert rules["member-death"].for_s == 0.0  # always immediate
+
+
+def test_rules_from_env_json_grammar(monkeypatch):
+    monkeypatch.setenv(
+        "GOL_ALERT_RULES",
+        '[{"name": "cups-floor", "signal": "cups", "op": "<", '
+        '"threshold": 100.0, "for_s": 2, "clear_s": 3}]')
+    rules = rules_from_env()
+    assert len(rules) == 1
+    r = rules[0]
+    assert (r.name, r.signal, r.op, r.threshold, r.for_s, r.clear_s) \
+        == ("cups-floor", "cups", "<", 100.0, 2.0, 3.0)
+
+
+def test_rules_from_env_garbage_is_ignored(monkeypatch):
+    monkeypatch.setenv("GOL_ALERT_RULES", "{not json")
+    assert rules_from_env() == []
+
+
+def test_bad_op_rejected():
+    with pytest.raises(ValueError):
+        AlertRule("x", "sig", op="!=")
+
+
+def test_doc_shape():
+    m, _ = manager(threshold=0.0, for_s=0.0)
+    m.evaluate({"sig": 1.0}, now=0.0)
+    doc = m.doc()
+    assert doc["states"]["r"] == "firing"
+    assert doc["rules"][0]["name"] == "r"
+    assert "r" in doc["active"]

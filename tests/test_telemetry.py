@@ -1,0 +1,342 @@
+"""Fleet telemetry plane (PR 16): snapshot export under the byte
+budget, commit-on-ack deltas, registry-side ingest + rollups, the
+audit log's durability contract, and the router's GetTelemetry /
+GetAudit wire surface.
+
+Router tests talk to an in-process FederationRouter over real
+sockets with synthetic RegisterMember beats — exactly the bytes a
+member's FederationAgent sends — so they pin ROUTER semantics
+without jax or a fleet engine (the full stack is
+tools/fleet_obs_smoke.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from gol_tpu import wire
+from gol_tpu.obs import audit as obs_audit
+from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import export as obs_export
+from gol_tpu.obs.audit import AuditLog
+from gol_tpu.obs.export import (
+    FleetTelemetry, SnapshotExporter, collect_families, snapshot_budget)
+from gol_tpu.obs.tsdb import TSDB
+
+
+@pytest.fixture(autouse=True)
+def _clean_member_event_queue():
+    obs_audit.commit_pending(10 ** 6)
+    yield
+    obs_audit.commit_pending(10 ** 6)
+
+
+def seed_gauges(res=4, q=2, cups=1.5e8, stale_p99=120.0):
+    obs.RUNS_RESIDENT.set(res)
+    obs.FLEET_QUEUE_DEPTH.set(q)
+    obs.ENGINE_CUPS.set(cups)
+    for qq in obs.SLO_QUANTILES:
+        obs.FLEET_STALENESS_MS.labels(q=qq).set(
+            stale_p99 if qq == "p99" else stale_p99 / 2)
+
+
+# ------------------------------------------------------------ export
+
+def test_collect_families_reads_the_catalog():
+    seed_gauges(res=7, q=3)
+    fam = collect_families()
+    assert fam["res"] == 7 and fam["q"] == 3
+    assert fam["st"]["p99"] == 120.0
+    assert fam["cups"] == pytest.approx(1.5e8)
+
+
+def test_full_then_delta_then_commit_on_ack():
+    seed_gauges(res=5, q=0)
+    ex = SnapshotExporter()
+    s1 = ex.build()
+    assert s1["full"] == 1 and s1["m"]["res"] == 5
+    # Unacked: the next build is STILL full (the beat was lost).
+    s_retry = ex.build()
+    assert s_retry.get("full") == 1
+    ex.commit({"registered": True})
+    s2 = ex.build()
+    assert "full" not in s2 and s2["m"] == {}  # nothing changed
+    ex.commit({"registered": True})
+    obs.RUNS_RESIDENT.set(6)
+    s3 = ex.build()
+    assert s3["m"].keys() == {"res"} and s3["m"]["res"] == 6
+
+
+def test_resync_ack_voids_the_baseline():
+    seed_gauges()
+    ex = SnapshotExporter()
+    ex.build()
+    ex.commit({"registered": True, "snap_resync": True})
+    assert ex.build().get("full") == 1
+
+
+def test_snapshot_disabled_by_nonpositive_budget(monkeypatch):
+    monkeypatch.setenv("GOL_FED_SNAPSHOT_MAX", "0")
+    assert snapshot_budget() == 0
+    assert SnapshotExporter().build() is None
+
+
+def test_over_budget_drops_lowest_priority_families(monkeypatch):
+    """Satellite 1's pinned contract: a fat snapshot degrades by
+    shedding its LOWEST-priority families (metered) — resident and
+    queue survive longest, and the result always fits the budget."""
+    seed_gauges(res=9, q=1)
+    for b in ("64x64x8", "128x128x8", "256x256x16"):
+        for qq in obs.SLO_QUANTILES:
+            obs.FLEET_QUANTUM_MS.labels(bucket=b, q=qq).set(12.345)
+    dropped0 = {f: obs.FED_SNAPSHOT_DROPPED.labels(family=f).value
+                for f in obs.SNAPSHOT_FAMILIES}
+    monkeypatch.setenv("GOL_FED_SNAPSHOT_MAX", "60")
+    snap = SnapshotExporter().build()
+    assert snap is not None
+    enc = json.dumps(snap, separators=(",", ":"), sort_keys=True)
+    assert len(enc) <= 60
+    assert snap["m"]["res"] == 9          # top priority survives
+    assert "qt" not in snap["m"]          # quantum quantiles shed
+    assert obs.FED_SNAPSHOT_DROPPED.labels(
+        family="quantum").value > dropped0["quantum"]
+    # Cleanup the quantum gauges so later collects stay small.
+    for b in ("64x64x8", "128x128x8", "256x256x16"):
+        for qq in obs.SLO_QUANTILES:
+            obs.FLEET_QUANTUM_MS.labels(bucket=b, q=qq).set(0.0)
+
+
+def test_dropped_families_reship_on_the_next_beat(monkeypatch):
+    seed_gauges(res=3, q=0, cups=1.25e8)
+    ex = SnapshotExporter()
+    monkeypatch.setenv("GOL_FED_SNAPSHOT_MAX", "40")
+    s1 = ex.build()
+    assert "cups" not in s1["m"]          # shed for budget
+    ex.commit({"registered": True})
+    monkeypatch.setenv("GOL_FED_SNAPSHOT_MAX", "4096")
+    s2 = ex.build()
+    assert s2["m"]["cups"] == pytest.approx(1.25e8)  # uncommitted: re-ships
+
+
+def test_events_ride_the_snapshot_with_commit_on_ack():
+    seed_gauges()
+    obs_audit.note("quarantine", run_id="r1", reason="step")
+    obs_audit.note("migrate", run_id="r1", phase="quiesce")
+    ex = SnapshotExporter()
+    s1 = ex.build()
+    assert [e["kind"] for e in s1["ev"]] == ["quarantine", "migrate"]
+    # Beat lost: events stay pending and re-ship.
+    s2 = ex.build()
+    assert len(s2["ev"]) == 2
+    ex.commit({"registered": True})
+    assert obs_audit.peek_pending() == []
+    assert len(obs_audit.recent()) >= 2  # local ring keeps the tail
+
+
+# ------------------------------------------------------------ ingest
+
+def make_telemetry(tmp_path=None):
+    log = AuditLog(path=str(tmp_path) if tmp_path else None)
+    return FleetTelemetry(tsdb=TSDB(max_series=64), audit_log=log)
+
+
+def members_doc(live, dead=0):
+    return {"members": [{"member_id": m, "state": "live"}
+                        for m in live]
+            + [{"member_id": f"dead{i}", "state": "dead"}
+               for i in range(dead)],
+            "live": len(live), "dead": dead}
+
+
+def test_rollups_are_exact_sums_and_max_staleness():
+    t = make_telemetry()
+    specs = {"m1": (2, 1, 1e6, 50.0), "m2": (3, 0, 2e6, 300.0),
+             "m3": (5, 4, 3e6, 100.0)}
+    for mid, (res, q, cups, p99) in specs.items():
+        ack = {}
+        t.ingest(mid, {"v": 1, "full": 1,
+                       "m": {"res": res, "q": q, "cups": cups,
+                             "st": {"p99": p99}}}, ack)
+        assert "snap_resync" not in ack
+    t.sweep(members_doc(["m1", "m2", "m3"]), now=1000.0)
+    fleet = t.doc()["fleet"]
+    assert fleet["runs_resident"] == 10   # exact sum
+    assert fleet["queue_depth"] == 5
+    assert fleet["cups"] == pytest.approx(6e6)
+    assert fleet["staleness_p99_ms"] == 300.0  # max across members
+    assert fleet["members_reporting"] == 3
+    assert fleet["imbalance_ratio"] == pytest.approx(5 / (10 / 3))
+    assert obs.FED_AGG_RUNS_RESIDENT.value == 10
+    assert obs.FED_AGG_STALENESS_MS.labels(q="p99").value == 300.0
+    # The tsdb saw the fleet series and each member series.
+    assert t.query("fleet.runs_resident")[-1]["last"] == 10.0
+    assert t.query("member.runs_resident",
+                   labels={"member": "m3"})[-1]["last"] == 5.0
+
+
+def test_delta_without_base_requests_resync_and_merges():
+    t = make_telemetry()
+    ack = {}
+    t.ingest("m1", {"v": 1, "m": {"res": 2}}, ack)  # delta, no base
+    assert ack.get("snap_resync") is True
+    t.sweep(members_doc(["m1"]), now=0.0)
+    assert t.doc()["fleet"]["runs_resident"] == 2  # merged anyway
+
+
+def test_dead_members_leave_the_rollup():
+    t = make_telemetry()
+    for mid, res in (("m1", 4), ("m2", 6)):
+        t.ingest(mid, {"v": 1, "full": 1, "m": {"res": res}}, {})
+    t.sweep(members_doc(["m1", "m2"]), now=0.0)
+    assert t.doc()["fleet"]["runs_resident"] == 10
+    t.sweep(members_doc(["m2"], dead=1), now=1.0)
+    assert t.doc()["fleet"]["runs_resident"] == 6
+    assert t.doc()["fleet"]["members_dead"] == 1
+
+
+def test_member_death_signal_fires_alert_and_audits(tmp_path):
+    t = make_telemetry(tmp_path)
+    t.ingest("m1", {"v": 1, "full": 1, "m": {"res": 1}}, {})
+    t.sweep(members_doc(["m1"]), now=0.0)
+    assert "member-death" not in t.doc()["alerts"]["active"]
+    tr = t.sweep(members_doc([], dead=1), now=1.0)
+    assert {"rule": "member-death", "event": "fired",
+            "value": 1.0} in tr
+    kinds = [r["kind"] for r in t.audit_tail()]
+    assert "alert_fired" in kinds
+
+
+def test_snapshot_events_land_in_the_durable_log(tmp_path):
+    t = make_telemetry(tmp_path)
+    t.ingest("m1", {"v": 1, "full": 1, "m": {},
+                    "ev": [{"schema": obs_audit.SCHEMA, "seq": 1,
+                            "ts": 123.0, "kind": "quarantine",
+                            "run_id": "r9", "reason": "step"}]}, {})
+    recs = t.audit_tail()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "quarantine" and rec["member"] == "m1"
+    assert rec["run_id"] == "r9" and rec["member_seq"] == 1
+
+
+# --------------------------------------------------------- audit log
+
+def test_audit_log_schema_seq_and_tail(tmp_path):
+    log = AuditLog(path=str(tmp_path))
+    for i in range(5):
+        log.append("adopt", run_id=f"r{i}", member="m1")
+    recs = log.tail()
+    assert [r["seq"] for r in recs] == [1, 2, 3, 4, 5]
+    assert all(r["schema"] == "gol-fleet-audit/1" for r in recs)
+    assert log.tail(since_seq=3) == recs[3:]
+    assert log.tail(limit=2) == recs[:2]
+    on_disk = [json.loads(line) for line in
+               open(tmp_path / "audit.jsonl", encoding="utf-8")]
+    assert on_disk == recs
+    log.close()
+
+
+def test_audit_log_rotation_is_size_capped(tmp_path):
+    log = AuditLog(path=str(tmp_path), max_bytes=4096, keep=2)
+    for i in range(200):
+        log.append("other", filler="x" * 64, i=i)
+    files = sorted(os.listdir(tmp_path))
+    assert "audit.jsonl" in files
+    assert "audit.jsonl.1" in files
+    assert len(files) <= 3  # current + keep
+    for f in files:
+        assert os.path.getsize(tmp_path / f) <= 4096 + 256
+    # seq stays monotonic across rotation; the ring tail still serves.
+    assert log.seq == 200
+    assert log.tail(since_seq=195)[-1]["seq"] == 200
+    log.close()
+
+
+def test_audit_memory_only_mode_keeps_ring():
+    log = AuditLog(path=None)
+    log.append("member_join", member="m")
+    assert log.tail()[0]["kind"] == "member_join"
+    log.close()
+
+
+# -------------------------------------------------- router wire face
+
+def router_beat(port, mid, seq, snap=None):
+    h = {"method": "RegisterMember", "member_id": mid, "address": mid,
+         "seq": seq, "capacity": 1}
+    if snap is not None:
+        h["snap"] = snap
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=10.0) as s:
+        wire.send_msg(s, h)
+        resp, _ = wire.recv_msg(s)
+    return resp
+
+
+def router_call(port, header):
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=10.0) as s:
+        wire.send_msg(s, header)
+        resp, _ = wire.recv_msg(s)
+    return resp
+
+
+def test_router_serves_telemetry_and_audit(tmp_path, monkeypatch):
+    monkeypatch.setenv("GOL_FED_HEARTBEAT", "0.2")
+    monkeypatch.setenv("GOL_FED_DEAD_AFTER", "60")
+    from gol_tpu.federation.router import FederationRouter
+    router = FederationRouter(port=0, audit_dir=str(tmp_path))
+    router.start_background()
+    try:
+        for i, res in enumerate((1, 2)):
+            router_beat(router.port, f"127.0.0.1:{9900 + i}", 1,
+                        {"v": 1, "full": 1, "m": {"res": res}})
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            doc = router.telemetry.doc()
+            if doc.get("fleet", {}).get("members_reporting") == 2:
+                break
+            time.sleep(0.05)
+        resp = router_call(router.port, {"method": "GetTelemetry"})
+        fleet = resp["telemetry"]["fleet"]
+        assert fleet["runs_resident"] == 3
+        assert resp["telemetry"]["tsdb"]["series"] >= 5
+        resp = router_call(router.port,
+                           {"method": "GetTelemetry",
+                            "series": "fleet.runs_resident"})
+        assert resp["telemetry"]["series"]["points"]
+        resp = router_call(router.port, {"method": "GetAudit"})
+        kinds = [r["kind"] for r in resp["records"]]
+        assert kinds.count("member_join") == 2
+        assert [r["seq"] for r in resp["records"]] == sorted(
+            r["seq"] for r in resp["records"])
+    finally:
+        router.shutdown()
+
+
+def test_router_death_fires_alert_within_sweep_cadence(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("GOL_FED_HEARTBEAT", "0.2")
+    monkeypatch.setenv("GOL_FED_DEAD_AFTER", "0.8")
+    from gol_tpu.federation.router import FederationRouter
+    router = FederationRouter(port=0, audit_dir=str(tmp_path))
+    router.start_background()
+    try:
+        router_beat(router.port, "127.0.0.1:9990", 1,
+                    {"v": 1, "full": 1, "m": {"res": 1}})
+        # Go silent: the sweep must declare death AND fire the alert.
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if "member-death" in router.telemetry.alerts.active():
+                break
+            time.sleep(0.05)
+        assert "member-death" in router.telemetry.alerts.active()
+        kinds = [r["kind"] for r in router.audit_log.tail()]
+        assert "member_death" in kinds and "alert_fired" in kinds
+    finally:
+        router.shutdown()

@@ -1,0 +1,162 @@
+"""tsdb semantics (PR 16): exact multi-tier downsampling, ring
+wraparound, the hard cardinality cap with least-recently-appended
+eviction, and thread-safety under concurrent ingest.
+
+All jax-free: the tsdb is registry-tier control plane (obs/tsdb.py)
+and must be testable in a process that never touches a device.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from gol_tpu.obs.tsdb import TSDB, tier_table
+
+
+def make(max_series=64):
+    return TSDB(max_series=max_series)
+
+
+# ---------------------------------------------------- downsampling
+
+def test_downsample_min_max_mean_last_exact_across_tiers():
+    """Every tier aggregates the RAW SAMPLES of its bucket — min/max/
+    mean/last are exact, not re-aggregations of a coarser tier."""
+    t = make()
+    # 120 s of 1-sample-per-second data: values 0..119 at ts 1000+i.
+    for i in range(120):
+        t.append("m", float(i), ts=1000.0 + i)
+    one_m = t.query("m", tier="1m")
+    # ts 1000..1019 land in bucket 960 (partial), 1020..1079 in 1020,
+    # 1080..1119 in 1080 (partial).
+    assert [p["t"] for p in one_m] == [960.0, 1020.0, 1080.0]
+    full = one_m[1]
+    assert full["count"] == 60
+    assert full["min"] == 20.0 and full["max"] == 79.0
+    assert full["mean"] == pytest.approx((20 + 79) / 2)
+    assert full["last"] == 79.0
+    # The 10m tier saw every sample exactly once too.
+    ten_m = t.query("m", tier="10m")
+    assert sum(p["count"] for p in ten_m) == 120
+    assert ten_m[-1]["last"] == 119.0
+    assert min(p["min"] for p in ten_m) == 0.0
+    assert max(p["max"] for p in ten_m) == 119.0
+
+
+def test_raw_tier_buckets_at_raw_resolution():
+    t = make()
+    for i in range(5):
+        t.append("m", float(i), ts=100.0 + 10 * i)  # one per raw bucket
+    raw = t.query("m", tier="raw")
+    assert [p["t"] for p in raw] == [100.0, 110.0, 120.0, 130.0, 140.0]
+    assert all(p["count"] == 1 for p in raw)
+
+
+def test_out_of_order_sample_merges_into_open_bucket():
+    """A stale timestamp can't resurrect a closed bucket: it merges
+    into the tail (sub-resolution reordering is lossless enough; a
+    closed ring slot is immutable)."""
+    t = make()
+    t.append("m", 1.0, ts=200.0)
+    t.append("m", 9.0, ts=150.0)  # older than the open bucket
+    raw = t.query("m", tier="raw")
+    assert len(raw) == 1 and raw[0]["count"] == 2
+    assert raw[0]["min"] == 1.0 and raw[0]["max"] == 9.0
+
+
+def test_query_since_filters_buckets():
+    t = make()
+    for i in range(10):
+        t.append("m", float(i), ts=100.0 + 10 * i)
+    late = t.query("m", tier="raw", since=150.0)
+    assert [p["t"] for p in late] == [150.0, 160.0, 170.0, 180.0, 190.0]
+
+
+# ------------------------------------------------------- wraparound
+
+def test_ring_wraparound_keeps_newest_capacity_buckets():
+    t = make()
+    cap = next(row["cap"] for row in tier_table()
+               if row["tier"] == "raw")
+    res = next(row["res_s"] for row in tier_table()
+               if row["tier"] == "raw")
+    n = cap + 25
+    for i in range(n):
+        t.append("m", float(i), ts=1000.0 + res * i)
+    raw = t.query("m", tier="raw")
+    assert len(raw) == cap  # fixed capacity, oldest evicted
+    assert raw[0]["t"] == 1000.0 + res * 25 - (1000.0 % res)
+    assert raw[-1]["last"] == float(n - 1)
+
+
+# ---------------------------------------------------- cardinality cap
+
+def test_cardinality_cap_evicts_least_recently_appended():
+    t = make(max_series=3)
+    t.append("a", 1.0, ts=10.0)
+    t.append("b", 1.0, ts=11.0)
+    t.append("c", 1.0, ts=12.0)
+    t.append("a", 2.0, ts=13.0)  # refresh a: b is now the LRU
+    t.append("d", 1.0, ts=14.0)  # cap hit: evicts b
+    names = {row["name"] for row in t.series_names()}
+    assert names == {"a", "c", "d"}
+    assert t.query("b") == []
+    doc = t.doc()
+    assert doc["series"] == 3
+    assert doc["evictions_total"] == 1
+
+
+def test_labels_distinguish_series_and_are_order_insensitive():
+    t = make()
+    t.append("m", 1.0, labels={"x": "1", "y": "2"}, ts=10.0)
+    t.append("m", 2.0, labels={"y": "2", "x": "1"}, ts=20.0)
+    t.append("m", 9.0, labels={"x": "other"}, ts=10.0)
+    pts = t.query("m", labels={"x": "1", "y": "2"}, tier="raw")
+    assert sum(p["count"] for p in pts) == 2
+    assert len(t.series_names()) == 2
+
+
+def test_non_numeric_value_is_ignored():
+    t = make()
+    t.append("m", "not-a-number", ts=10.0)
+    assert t.query("m") == []
+
+
+# ----------------------------------------------------- thread safety
+
+def test_concurrent_ingest_loses_nothing_and_respects_cap():
+    t = make(max_series=8)
+    n_threads, per = 8, 500
+    errs = []
+
+    def pump(k):
+        try:
+            for i in range(per):
+                t.append(f"s{k}", float(i), ts=1000.0 + i)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=pump, args=(k,))
+               for k in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    doc = t.doc()
+    assert doc["points_total"] == n_threads * per
+    assert doc["series"] == 8  # all fit: no eviction churn
+    for k in range(n_threads):
+        pts = t.query(f"s{k}", tier="10m")
+        assert sum(p["count"] for p in pts) == per
+
+
+def test_doc_carries_retention_table():
+    doc = make().doc()
+    tiers = {row["tier"]: row for row in doc["tiers"]}
+    assert set(tiers) == {"raw", "1m", "10m"}
+    assert tiers["1m"]["res_s"] == 60.0
+    for row in tiers.values():
+        assert row["span_s"] == row["res_s"] * row["cap"]

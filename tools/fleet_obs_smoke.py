@@ -1,0 +1,243 @@
+"""fleet-obs-smoke: prove the fleet telemetry plane end to end on CPU.
+
+One acceptance scenario (PR 16), real member processes behind a real
+in-process router:
+
+  * three `--fleet --federate` servers heartbeat to a FederationRouter
+    with telemetry snapshots riding the beats; runs created THROUGH
+    the router are HRW-placed and parked at a target turn;
+  * the router's rollup must agree EXACTLY with the per-member states
+    it ingested at the same sweep — gol_fed_agg_runs_resident equals
+    both the sum of the member table rows and the number of runs the
+    fleet actually holds — and every heartbeat payload the registry
+    saw must be within the GOL_FED_SNAPSHOT_MAX byte budget;
+  * GetTelemetry / GetAudit answer over the wire (fleet doc, tsdb
+    series points, monotonic gol-fleet-audit/1 join records), the
+    router's /healthz carries the telemetry doc, and
+    tools/fleet_top.py renders one dashboard frame headless;
+  * one member is SIGKILLed: the member-death alert must FIRE within
+    the detection budget, flip gol_alerts_active{rule="member-death"}
+    to 1, and land both a member_death and an alert_fired record in
+    the durable audit log on disk.
+
+Exit 0 = pass.
+
+    make fleet-obs-smoke    # bench.py --fleet-obs + gate, then this
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.federation_smoke import (  # noqa: E402
+    FED_ENV, spawn_member, wait_live, wait_member, wait_runs_at)
+
+# SIGKILL -> firing alert: death verdict (GOL_FED_DEAD_AFTER 1.2 s)
+# + one sweep (0.1 s) + alert evaluation (for_s=0 for member-death),
+# with CI slack on a loaded CPU host.
+DETECT_BUDGET_S = 15.0
+
+
+def fail(msg: str) -> int:
+    print(f"fleet-obs-smoke: FAIL — {msg}", flush=True)
+    return 1
+
+
+def read_audit_files(audit_dir: str) -> list:
+    """Every gol-fleet-audit/1 record on disk, oldest first."""
+    recs = []
+    for name in sorted(os.listdir(audit_dir), reverse=True):
+        if not name.startswith("audit.jsonl"):
+            continue
+        with open(os.path.join(audit_dir, name), encoding="utf-8") as f:
+            recs.extend(json.loads(line) for line in f if line.strip())
+    recs.sort(key=lambda r: r.get("seq", 0))
+    return recs
+
+
+def wait_telemetry(router, n_members: int, n_runs: int,
+                   timeout: float = 60.0):
+    """The telemetry doc once every member reports and the rollup
+    holds all runs, or None."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = router.telemetry.doc()
+        fleet = doc.get("fleet", {})
+        if fleet.get("members_reporting") == n_members \
+                and fleet.get("runs_resident") == n_runs:
+            return doc
+        time.sleep(0.2)
+    return None
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("GOL_CHAOS", None)
+    os.environ.update(FED_ENV)
+
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.federation.router import FederationRouter
+    from gol_tpu.obs import catalog as obs
+    from gol_tpu.obs.export import snapshot_budget
+    from gol_tpu.obs.http import healthz_doc
+    from tools import fleet_top
+
+    tmpdir = tempfile.mkdtemp(prefix="gol_fleet_obs_smoke_")
+    ckpt_root = os.path.join(tmpdir, "ck")
+    audit_dir = os.path.join(tmpdir, "audit")
+    # Runs get NO target turn: a parked run leaves the `resident`
+    # state, and this smoke pins the resident-sum rollup — so the
+    # boards keep stepping for the whole scenario.
+    n_members, n_runs, placed_turn = 3, 5, 4
+
+    router = FederationRouter(port=0,
+                              audit_dir=audit_dir).start_background()
+    procs = [spawn_member(tmpdir, ckpt_root, router.port)
+             for _ in range(n_members)]
+    members = {}
+    try:
+        for p in procs:
+            addr = wait_member(p)
+            if addr is None:
+                return fail("a member never announced its port")
+            members[addr] = p
+        if not wait_live(router, n_members):
+            return fail("registry never reached 3 live members")
+
+        cli = RemoteEngine(f"127.0.0.1:{router.port}", timeout=60.0)
+        rng = np.random.default_rng(16)
+        run_ids = []
+        for i in range(n_runs):
+            rid = f"obs{i}"
+            board = (rng.random((64, 64)) < 0.3).astype(np.uint8)
+            cli.create_run(64, 64, board=board, run_id=rid,
+                           ckpt_every=4)
+            run_ids.append(rid)
+        owners = wait_runs_at(cli, run_ids, placed_turn)
+        if owners is None:
+            return fail("runs never started stepping on their members")
+
+        # ---- rollup exactness at one sweep -------------------------
+        doc = wait_telemetry(router, n_members, n_runs)
+        if doc is None:
+            return fail(f"rollup never converged: "
+                        f"{router.telemetry.doc().get('fleet')}")
+        fleet = doc["fleet"]
+        member_sum = sum(r["resident"] for r in doc["members"].values())
+        if fleet["runs_resident"] != member_sum:
+            return fail(f"rollup {fleet['runs_resident']} != member "
+                        f"table sum {member_sum}")
+        if fleet["runs_resident"] != n_runs:
+            return fail(f"rollup {fleet['runs_resident']} != "
+                        f"{n_runs} created runs")
+        if obs.FED_AGG_RUNS_RESIDENT.value != n_runs:
+            return fail("gol_fed_agg_runs_resident gauge disagrees")
+        if fleet["cups"] < 0 or fleet["imbalance_ratio"] < 1.0:
+            return fail(f"implausible rollup: {fleet}")
+        print(f"fleet-obs-smoke: rollup exact — {n_runs} resident "
+              f"across {n_members} members, imbalance "
+              f"{fleet['imbalance_ratio']}", flush=True)
+
+        # ---- every ingested heartbeat within the byte budget -------
+        budget = snapshot_budget()
+        p99 = obs.FED_AGG_PAYLOAD_BYTES.labels(q="p99").value
+        if not p99 or p99 > budget:
+            return fail(f"snapshot payload p99 {p99} outside "
+                        f"(0, {budget}]")
+
+        # ---- wire surface + dashboard ------------------------------
+        tdoc = cli.get_telemetry(series="fleet.runs_resident")
+        if tdoc.get("fleet", {}).get("runs_resident") != n_runs:
+            return fail(f"GetTelemetry fleet doc wrong: "
+                        f"{tdoc.get('fleet')}")
+        if not tdoc.get("series", {}).get("points"):
+            return fail("GetTelemetry returned no tsdb points for "
+                        "fleet.runs_resident")
+        joins = [r for r in cli.get_audit(limit=200)
+                 if r["kind"] == "member_join"]
+        if len(joins) != n_members:
+            return fail(f"{len(joins)} member_join audit records, "
+                        f"want {n_members}")
+        seqs = [r["seq"] for r in joins]
+        if seqs != sorted(seqs):
+            return fail(f"audit seqs not monotonic: {seqs}")
+        hz = healthz_doc().get("telemetry")
+        if not hz or hz.get("fleet", {}).get("members_reporting") \
+                != n_members:
+            return fail(f"/healthz telemetry doc wrong: {hz}")
+        frame_out = io.StringIO()
+        with contextlib.redirect_stdout(frame_out):
+            rc = fleet_top.main(
+                ["--router", f"127.0.0.1:{router.port}", "--once"])
+        frame = frame_out.getvalue()
+        if rc != 0 or "fleet " not in frame or "MEMBER" not in frame:
+            return fail(f"fleet_top --once rc={rc}, frame:\n{frame}")
+        print("fleet-obs-smoke: wire + /healthz + fleet_top frame ok",
+              flush=True)
+
+        # ---- SIGKILL -> member-death alert + audit record ----------
+        victim = owners[run_ids[0]]
+        os.kill(members[victim].pid, signal.SIGKILL)
+        members[victim].wait(10)
+        t_kill = time.monotonic()
+        while time.monotonic() - t_kill < DETECT_BUDGET_S:
+            if "member-death" in router.telemetry.alerts.active():
+                break
+            time.sleep(0.05)
+        detect_s = time.monotonic() - t_kill
+        if "member-death" not in router.telemetry.alerts.active():
+            return fail(f"member-death alert did not fire within "
+                        f"{DETECT_BUDGET_S}s of SIGKILL")
+        if obs.ALERTS_ACTIVE.labels(rule="member-death").value != 1:
+            return fail("gol_alerts_active{rule=member-death} != 1")
+        # The alert shows active the instant the sweep promotes it;
+        # the audit append trails by a beat — poll the files.
+        deadline = time.monotonic() + 10.0
+        recs, kinds = [], []
+        while time.monotonic() < deadline:
+            recs = read_audit_files(audit_dir)
+            kinds = [r["kind"] for r in recs]
+            if "member_death" in kinds and "alert_fired" in kinds:
+                break
+            time.sleep(0.1)
+        if "member_death" not in kinds:
+            return fail(f"no member_death audit record on disk: "
+                        f"{kinds}")
+        death = next(r for r in recs if r["kind"] == "member_death")
+        if death.get("member") != victim:
+            return fail(f"member_death names {death.get('member')}, "
+                        f"killed {victim}")
+        fired = [r for r in recs if r["kind"] == "alert_fired"
+                 and r.get("rule") == "member-death"]
+        if not fired:
+            return fail("no alert_fired audit record for member-death")
+        if any(r.get("schema") != "gol-fleet-audit/1" for r in recs):
+            return fail("audit records missing gol-fleet-audit/1 "
+                        "schema stamp")
+        print(f"fleet-obs-smoke: member-death fired {detect_s:.2f}s "
+              f"after SIGKILL, audit log has {len(recs)} records",
+              flush=True)
+        print("fleet-obs-smoke: PASS", flush=True)
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(10)
+        router.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

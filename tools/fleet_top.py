@@ -1,0 +1,153 @@
+"""fleet-top: a terminal dashboard for the fleet telemetry plane.
+
+Polls a federation router's GetTelemetry / GetAudit wire methods (PR
+16) and renders, once per interval:
+
+  * the fleet rollup line — resident runs, aggregate CUPS, queue
+    depth, staleness p99, imbalance ratio, live/dead member counts;
+  * a per-member table from the registry's snapshot states;
+  * active alerts (rule + how long they have been firing);
+  * the tail of the gol-fleet-audit/1 log (newest last), streamed
+    incrementally by `since_seq` so each frame only fetches records
+    it has not seen.
+
+    python tools/fleet_top.py --router HOST:PORT            # live
+    python tools/fleet_top.py --router HOST:PORT --once     # one frame
+
+`--once` prints a single frame and exits 0 — that head-less mode is
+what tools/fleet_obs_smoke.py runs in CI. Rendering is pure
+(`render(doc, records)` returns a string), so the smoke can also call
+it in-process on a fetched doc.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from gol_tpu.client import RemoteEngine  # noqa: E402
+
+
+def _si(v: float) -> str:
+    """1234567 -> '1.2M' — compact engineering notation."""
+    for div, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= div:
+            return f"{v / div:.1f}{suffix}"
+    return f"{v:.0f}"
+
+
+def render(doc: dict, records: list, now: float = None) -> str:
+    """One dashboard frame from a GetTelemetry doc and an audit tail
+    (oldest first). Pure string building — no I/O, no client."""
+    if now is None:
+        now = time.time()
+    fleet = doc.get("fleet", {})
+    lines = []
+    lines.append(
+        "fleet  runs={runs}  cups={cups}  queue={q}  "
+        "stale_p99={st:.0f}ms  imbalance={imb:.2f}  "
+        "members={live} live / {dead} dead".format(
+            runs=fleet.get("runs_resident", 0),
+            cups=_si(float(fleet.get("cups", 0.0))),
+            q=fleet.get("queue_depth", 0),
+            st=float(fleet.get("staleness_p99_ms", 0.0)),
+            imb=float(fleet.get("imbalance_ratio", 1.0)),
+            live=fleet.get("members_live", 0),
+            dead=fleet.get("members_dead", 0)))
+    tsdb = doc.get("tsdb", {})
+    payload = doc.get("payload_bytes", {})
+    lines.append(
+        "plane  tsdb {series} series / {pts} pts  "
+        "snap_p99={p99}B  audit_seq={seq}".format(
+            series=tsdb.get("series", 0),
+            pts=tsdb.get("points_total", 0),
+            p99=payload.get("p99", "-"),
+            seq=doc.get("audit_seq", 0)))
+    lines.append("")
+
+    members = doc.get("members", {})
+    lines.append(f"{'MEMBER':<22} {'RUNS':>5} {'QUEUE':>6} "
+                 f"{'CUPS':>8} {'STALE_P99':>10} {'SLO':>4}")
+    for mid, row in sorted(members.items()):
+        lines.append(
+            f"{mid:<22} {row.get('resident', 0):>5} "
+            f"{row.get('queue_depth', 0):>6} "
+            f"{_si(float(row.get('cups', 0.0))):>8} "
+            f"{row.get('staleness_p99_ms', 0.0):>8.0f}ms "
+            f"{row.get('slo_breaches', 0):>4}")
+    if not members:
+        lines.append("  (no members reporting)")
+    lines.append("")
+
+    alerts = doc.get("alerts", {})
+    active = alerts.get("active", {})
+    if active:
+        for rule, st in sorted(active.items()):
+            since = float(st.get("since", now))
+            lines.append(
+                f"ALERT  {rule}  value={st.get('value')}  "
+                f"firing {max(0.0, now - since):.0f}s")
+    else:
+        lines.append("alerts: none active")
+    lines.append("")
+
+    lines.append("audit (newest last):")
+    for rec in records[-10:]:
+        extra = " ".join(
+            f"{k}={rec[k]}" for k in
+            ("member", "run_id", "rule", "reason", "phase", "target")
+            if k in rec)
+        lines.append(f"  #{rec.get('seq', '?'):>4} "
+                     f"{rec.get('kind', '?'):<16} {extra}")
+    if not records:
+        lines.append("  (empty)")
+    return "\n".join(lines)
+
+
+def fetch_frame(client: RemoteEngine, since_seq: int) -> tuple:
+    """(telemetry_doc, new_audit_records) — one poll of the router."""
+    doc = client.get_telemetry()
+    records = client.get_audit(since_seq=since_seq, limit=200)
+    return doc, records
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="terminal dashboard over GetTelemetry/GetAudit")
+    ap.add_argument("--router", required=True,
+                    help="federation router HOST:PORT")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period, seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print a single frame and exit (CI mode)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    client = RemoteEngine(args.router, timeout=args.timeout)
+    seen_seq = 0
+    tail: list = []
+    try:
+        while True:
+            doc, fresh = fetch_frame(client, seen_seq)
+            for rec in fresh:
+                seen_seq = max(seen_seq, int(rec.get("seq", 0)))
+            tail = (tail + fresh)[-200:]
+            frame = render(doc, tail)
+            if args.once:
+                print(frame)
+                return 0
+            # Full-screen repaint: clear + home, then the frame.
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

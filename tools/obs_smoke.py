@@ -146,7 +146,35 @@ def main() -> int:
                        "# TYPE gol_shard_imbalance_ratio gauge",
                        "# TYPE gol_dev_kind_devices gauge",
                        "# TYPE gol_dev_mem_stats_supported gauge",
-                       'gol_halo_bytes_total{axis="rows"}'):
+                       'gol_halo_bytes_total{axis="rows"}',
+                       # PR 16 fleet telemetry plane (member-side
+                       # snapshot export + registry rollups + tsdb +
+                       # alerting + audit — all pre-seeded in the
+                       # catalog, so they expose on every process)
+                       "# TYPE gol_fed_snapshot_bytes gauge",
+                       "# TYPE gol_fed_snapshot_total counter",
+                       'gol_fed_snapshot_total{kind="full"}',
+                       'gol_fed_snapshot_total{kind="delta"}',
+                       'gol_fed_snapshot_dropped_total{family="quantum"}',
+                       'gol_fed_snapshot_dropped_total{family="events"}',
+                       "# TYPE gol_fed_snapshot_ingested_total counter",
+                       "# TYPE gol_fed_agg_runs_resident gauge",
+                       "# TYPE gol_fed_agg_queue_depth gauge",
+                       "# TYPE gol_fed_agg_cups gauge",
+                       'gol_fed_agg_staleness_ms{q="p99"}',
+                       "# TYPE gol_fed_agg_imbalance_ratio gauge",
+                       "# TYPE gol_fed_agg_members_reporting gauge",
+                       "# TYPE gol_fed_agg_slo_breaches_total gauge",
+                       "# TYPE gol_fed_agg_dev_live_bytes gauge",
+                       'gol_fed_agg_payload_bytes{q="p50"}',
+                       "# TYPE gol_tsdb_series gauge",
+                       "# TYPE gol_tsdb_points_total gauge",
+                       "# TYPE gol_tsdb_evictions_total gauge",
+                       'gol_alerts_active{rule="member-death"}',
+                       'gol_alerts_active{rule="queue-depth"}',
+                       'gol_alerts_fired_total{rule="member-death"}',
+                       'gol_audit_records_total{kind="member_death"}',
+                       'gol_audit_records_total{kind="quarantine"}'):
             if needle not in body:
                 problems.append(f"/metrics missing {needle!r}")
         if 'gol_profile_captures_total{status="ok"} 1' not in body:
@@ -159,6 +187,24 @@ def main() -> int:
         else:
             problems.append("no gol_engine_turn sample")
         base_url = srv.url.rsplit("/", 1)[0]
+        # /metrics.json must carry the same gol_fed_* telemetry
+        # families as the text exposition (federated members serve
+        # their per-member values through this path).
+        mjson = json.loads(urllib.request.urlopen(
+            base_url + "/metrics.json", timeout=10).read().decode())
+        for fam in ("gol_fed_snapshot_bytes", "gol_fed_snapshot_total",
+                    "gol_fed_agg_runs_resident",
+                    "gol_fed_agg_imbalance_ratio",
+                    "gol_tsdb_series", "gol_alerts_active",
+                    "gol_audit_records_total"):
+            if fam not in mjson:
+                problems.append(f"/metrics.json missing {fam!r}")
+        alerts_rules = {v["labels"].get("rule")
+                        for v in mjson.get("gol_alerts_active",
+                                           {}).get("values", [])}
+        if not {"member-death", "queue-depth"} <= alerts_rules:
+            problems.append(
+                f"/metrics.json gol_alerts_active rules: {alerts_rules}")
         healthz = json.loads(urllib.request.urlopen(
             base_url + "/healthz", timeout=10).read().decode())
         for field in ("device_kind", "live_bytes", "compile_count",

@@ -67,7 +67,9 @@ DEFAULT_GATE_PATTERN = (
     r"|downtime_p\d+_ms|migration_downtime_p\d+_ms"
     r"|router_overhead_p\d+_ms"
     r"|halo (?:bytes|exchanges)/turn"
-    r"|encode_calls_per_published_frame|viewer_fanout_p\d+_ms")
+    r"|encode_calls_per_published_frame|viewer_fanout_p\d+_ms"
+    r"|telemetry_overhead_pct|heartbeat_payload_p\d+_bytes"
+    r"|alert_detection_p\d+_ms")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
