@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -171,8 +171,23 @@ fleet-obs-smoke:
 	python tools/perf_compare.py BASELINE.json out/fleet_obs_smoke.jsonl
 	JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py
 
+# Event-sourced run journal (PR 17): bench.py --journal measures the
+# hash-chained black box's steady-state cost (journal on vs off, same
+# board) and gates journal_overhead_pct <= 2% via BASELINE.json.
+# tools/journal_smoke.py then proves the journal end to end: a
+# federated 1000-turn run through a mid-flight SetRule and one SIGKILL
+# failover, chain-verified and deterministically replayed by
+# tools/replay_audit.py with bit-identical digests at every digest
+# event (exit nonzero on divergence).
+journal-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --journal \
+		| tee out/journal_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/journal_smoke.jsonl
+	JAX_PLATFORMS=cpu python tools/journal_smoke.py
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

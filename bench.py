@@ -1244,6 +1244,120 @@ def bench_overhead(sizes=(512, 1024), turns: int = 0) -> int:
     return rc
 
 
+# Journal leg sizing (PR 17): one 512² full-engine-stack run timed with
+# the hash-chained journal off vs on, interleaved best-of-N per side so
+# slow host drift cannot masquerade as journal cost. GOL_MAX_CHUNK is
+# pinned to the digest cadence so chunking is identical on both sides
+# and every digest lands at an exact multiple of the cadence.
+JOURNAL_BOARD = 512
+JOURNAL_TURNS = 16_384
+JOURNAL_DIGEST_EVERY = 512
+JOURNAL_REPEATS = 3
+
+
+def bench_journal(turns: int = 0) -> int:
+    """Event-sourced journal steady-state cost (PR 17): a 512²
+    engine-stack run with journaling on (GOL_JOURNAL at a tempdir,
+    host-side board digests every JOURNAL_DIGEST_EVERY turns at chunk
+    boundaries). The GATED number is gol_journal_wall_us_total — the
+    wall time spent inside the journal hot path (seed encode, board
+    digests, chained appends), instrumented in-process — as a
+    percentage of the on-run's wall, summed over JOURNAL_REPEATS
+    rounds. Same cost-accounting pattern as telemetry_overhead_pct: a
+    direct measure that cannot flap with host contention the way a
+    differential wall clock between two runs does (the off legs still
+    run, interleaved, and their raw differential rides in detail as
+    context). Gates against the <= 2% ceiling in BASELINE.json (lower
+    is better); hard-fails independently of the perf gate when the on
+    legs journaled no digest events — a 0% overhead from dead hooks
+    must not pass."""
+    import os
+    import tempfile
+
+    from gol_tpu import journal as journal_mod
+    from gol_tpu.engine import Engine
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.params import Params
+
+    turns = turns or JOURNAL_TURNS
+    n = JOURNAL_BOARD
+    knobs = ("GOL_MAX_CHUNK", "GOL_CHUNK_TARGET", "GOL_PIPELINE_DEPTH",
+             "GOL_PIPELINE_BUDGET", "GOL_MESH", "GOL_CKPT",
+             "GOL_CKPT_EVERY", "GOL_CKPT_EVERY_TURNS", "GOL_CKPT_KEEP",
+             "GOL_CKPT_KEEP_EVERY", "GOL_TRACE", "GOL_RULE",
+             "GOL_JOURNAL", "GOL_JOURNAL_DIGEST_EVERY")
+    saved = {v: os.environ.get(v) for v in knobs}
+    rng = np.random.default_rng(0)
+    world = ((rng.random((n, n)) < 0.25).astype(np.uint8)) * 255
+    p = Params(threads=8, image_width=n, image_height=n, turns=turns)
+    best = {"off": None, "on": None}
+    on_elapsed = 0.0
+    digests0 = obs_cat.JOURNAL_DIGESTS.value
+    bytes0 = obs_cat.JOURNAL_BYTES.value
+    wall0 = obs_cat.JOURNAL_WALL_US.value
+    try:
+        for v in knobs:
+            os.environ.pop(v, None)
+        os.environ["GOL_MAX_CHUNK"] = str(JOURNAL_DIGEST_EVERY)
+        os.environ["GOL_JOURNAL_DIGEST_EVERY"] = str(
+            JOURNAL_DIGEST_EVERY)
+        # warm: compile the chunk ladder once so neither timed side
+        # pays a compile stall
+        Engine().server_distributor(p, world)
+        with tempfile.TemporaryDirectory() as jdir:
+            for _ in range(JOURNAL_REPEATS):
+                for leg in ("off", "on"):
+                    if leg == "on":
+                        os.environ["GOL_JOURNAL"] = jdir
+                    else:
+                        os.environ.pop("GOL_JOURNAL", None)
+                    eng = Engine()
+                    t0 = time.perf_counter()
+                    eng.server_distributor(p, world)
+                    dt = time.perf_counter() - t0
+                    if leg == "on":
+                        on_elapsed += dt
+                    if best[leg] is None or dt < best[leg]:
+                        best[leg] = dt
+            journal_mod.reset()
+    finally:
+        journal_mod.reset()
+        for v, val in saved.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
+    digests = int(obs_cat.JOURNAL_DIGESTS.value - digests0)
+    jbytes = int(obs_cat.JOURNAL_BYTES.value - bytes0)
+    wall_s = (obs_cat.JOURNAL_WALL_US.value - wall0) / 1e6
+    # Gated: the instrumented journal wall as a share of the on-runs'
+    # wall. The raw off-vs-on differential is context only — on a
+    # contended host it flaps by multiples of the real cost.
+    pct = wall_s / on_elapsed * 100.0 if on_elapsed > 0 else 0.0
+    diff_pct = (best["on"] - best["off"]) / best["off"] * 100.0
+    _emit("journal_overhead_pct", round(pct, 3), "%", None,
+          {"size": n, "turns": turns,
+           "digest_every": JOURNAL_DIGEST_EVERY,
+           "repeats": JOURNAL_REPEATS,
+           "journal_wall_s": round(wall_s, 5),
+           "on_elapsed_s": round(on_elapsed, 4),
+           "best_off_s": round(best["off"], 4),
+           "best_on_s": round(best["on"], 4),
+           "wall_diff_pct": round(diff_pct, 3),
+           "digests": digests, "journal_bytes": jbytes,
+           "method": "in-process gol_journal_wall_us_total share of "
+                     "the on-runs' wall (seed encode + board digests "
+                     "+ chained appends); wall_diff_pct is the "
+                     "interleaved best-of-N off-vs-on differential, "
+                     "context only"})
+    if digests <= 0:
+        print("BENCH LEG FAILED (journal): the on legs journaled no "
+              "digest events — overhead number is meaningless",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 # Fleet leg sizing: run counts spanning single-run through saturated
 # batch, each measured over a free-running wall-clock window. The 512
 # count is the ISSUE's acceptance point (aggregate cups >= 10x a
@@ -3075,6 +3189,13 @@ def main() -> int:
                          "(emits the gated telemetry_overhead_pct / "
                          "heartbeat_payload_p99_bytes / "
                          "alert_detection_p99_ms lines)")
+    ap.add_argument("--journal", action="store_true",
+                    help="run the event-sourced journal overhead leg "
+                         "only: the same 512² engine run timed with "
+                         "GOL_JOURNAL off vs on, board digests every "
+                         f"{JOURNAL_DIGEST_EVERY} turns (emits the "
+                         "gated journal_overhead_pct line; combine "
+                         "only with --turns)")
     ap.add_argument("--migrate", action="store_true",
                     help="run the live-migration leg only: 3 --fleet "
                          "--federate member processes behind an "
@@ -3207,7 +3328,7 @@ def _dispatch(args, ap) -> int:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
-                or args.mesh or args.migrate \
+                or args.mesh or args.migrate or args.journal \
                 or args.size is not None \
                 or args.turns is not None:
             ap.error("--federation is its own config; it takes no "
@@ -3218,7 +3339,8 @@ def _dispatch(args, ap) -> int:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
-                or args.mesh or args.size is not None \
+                or args.mesh or args.journal \
+                or args.size is not None \
                 or args.turns is not None:
             ap.error("--migrate is its own config; it takes no "
                      "other leg flags")
@@ -3228,11 +3350,23 @@ def _dispatch(args, ap) -> int:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
-                or args.mesh or args.size is not None \
+                or args.mesh or args.journal \
+                or args.size is not None \
                 or args.turns is not None:
             ap.error("--fleet-obs is its own config; it takes no "
                      "other leg flags")
         return bench_fleet_obs()
+
+    if args.journal:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.chaos or args.fleet or args.load \
+                or args.mesh or args.fuse or args.broadcast \
+                or args.size is not None:
+            ap.error("--journal is its own config; combine only with "
+                     "--turns")
+        return bench_journal(
+            turns=args.turns if args.turns is not None else 0)
 
     if args.fuse:
         if args.pattern != "dense" or args.gen or args.engine \

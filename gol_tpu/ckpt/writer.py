@@ -295,10 +295,37 @@ class CheckpointWriter:
             if snap.extra:
                 manifest["sparse"] = {k: int(v)
                                       for k, v in snap.extra.items()}
+            jinfo = self._journal_digest(snap, manifest)
+            if jinfo is not None:
+                # The chain head rides the manifest: a restore/adoption
+                # knows the newest journal state the checkpoint covers,
+                # and a verifier can prove the file wasn't truncated.
+                manifest["journal"] = jinfo
             mf.write_manifest(man_path, manifest)  # durability bit LAST
             obs.CKPT_BYTES.inc(payload_bytes)
             self.retention.apply(self.directory, locked=True)
         return man_path
+
+    def _journal_digest(self, snap: Snapshot,
+                        manifest: dict) -> Optional[dict]:
+        """Journal one board-digest event for this checkpoint and return
+        the chain head to stamp into the manifest, or None while the run
+        isn't journaling. Runs on the writer/pool thread — the board
+        hash was already computed for the manifest, so the journal rides
+        the checkpoint for free (the fleet's bounded writer pool is the
+        only thread that ever touches a resident's journal digests)."""
+        try:
+            from gol_tpu import journal as journal_mod
+
+            jw = journal_mod.get(self.run_id)
+            if jw is None:
+                return None
+            jw.digest(snap.turn, manifest["board_sha256"],
+                      repr_=snap.repr, trigger=snap.trigger,
+                      alive=manifest["alive"])
+            return jw.head_info()
+        except Exception:  # journaling must never sink a checkpoint
+            return None
 
 
 # Shared fleet pool sizing: a couple of workers keep up with hundreds

@@ -463,6 +463,21 @@ class RemoteEngine:
             timeout=self._timeout)
         return list(resp.get("records", []))
 
+    def get_journal(self, since_seq: int = -1,
+                    limit: int = 100) -> dict:
+        """This run's hash-chained gol-journal/1 tail: {"head", "seq",
+        "path", "records"} with records of seq > since_seq, oldest
+        first. The run_id rides the standard header, so a
+        RemoteEngine bound to a fleet run (or reached through the
+        federation router) reads that run's black box."""
+        resp, _ = self._call(
+            {"method": "GetJournal", "since_seq": int(since_seq),
+             "limit": int(limit)},
+            timeout=self._timeout)
+        return {"head": resp.get("head"), "seq": resp.get("seq"),
+                "path": resp.get("path"),
+                "records": list(resp.get("records", []))}
+
     def abort_run(self) -> bool:
         """Stop the engine's current run IF it is this controller's own
         (token match); returns whether an abort was delivered."""

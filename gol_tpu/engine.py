@@ -46,6 +46,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from gol_tpu import journal as journal_mod
 from gol_tpu.fleet.handles import SingleRunSurface
 from gol_tpu.models.generations import GenerationsRule
 from gol_tpu.models.lifelike import CONWAY
@@ -871,6 +872,47 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                                        minimum=0))
                 next_ckpt_turn = (
                     start_turn // ckpt_every_turns + 1) * ckpt_every_turns
+        # Event-sourced journal (GOL_JOURNAL): the run's hash-chained
+        # black box. The create event pins the seed — inline for small
+        # packed/u8 boards, digest-only otherwise — and digest events
+        # land at exact turn boundaries below so a replay can assert
+        # bit-identity mid-history, not just at the end.
+        journal_writer = None
+        next_digest_turn = None
+        digest_every_turns = 0
+        if journal_mod.enabled():
+            journal_writer = journal_mod.for_run(obs_flight.RUN_ID)
+        if journal_writer is not None:
+            digest_every_turns = journal_mod.digest_every()
+            if digest_every_turns > 0:
+                next_digest_turn = (
+                    start_turn // digest_every_turns + 1
+                ) * digest_every_turns
+            try:
+                _jhost = np.asarray(jax.device_get(cells))
+                if pad_rows:
+                    _jhost = _jhost[..., : _jhost.shape[-2] - pad_rows, :]
+                _jseed = None
+                if height * width <= (1 << 22):
+                    if repr_ == "u8":
+                        _jseed = journal_mod.encode_board(_jhost)
+                    elif repr_ == "packed":
+                        from gol_tpu.ops.bitpack import (
+                            unpack_np, words_bytes_np)
+                        _jseed = journal_mod.encode_board(unpack_np(
+                            words_bytes_np(_jhost), height, width))
+                _jfields = dict(
+                    turn=start_turn, h=height, w=width,
+                    rule=self._rule.rulestring, repr=repr_,
+                    fuse_k=fuse_eff,
+                    board_sha256=journal_mod.board_digest(_jhost, repr_))
+                if _jseed is not None:
+                    _jfields["seed"] = _jseed
+                journal_writer.append("create", **_jfields)
+                del _jhost, _jseed
+            except Exception:  # journaling must never sink a run
+                journal_writer = None
+                next_digest_turn = None
 
         def _ckpt_submit(snap_cells, trigger: str) -> None:
             """Queue a checkpoint of `snap_cells` at self._turn on the
@@ -1025,7 +1067,7 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             nonlocal chunk, last_pop, ramping, flag_pending, last_devpoll
             nonlocal pend_chunks, pend_turns, wait_accum
             nonlocal last_cups, last_rate, last_done_turn
-            (_done_cells, done_token, done_k, done_turn,
+            (done_cells, done_token, done_k, done_turn,
              done_issue, done_span) = inflight.popleft()
             t_wait = time.monotonic()
             done_alive = int(np.asarray(
@@ -1089,6 +1131,28 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                 done_span.attrs.update(alive=done_alive,
                                        token_wait_s=round(token_wait, 6))
                 obs_trace.finish(done_span)
+            if (journal_writer is not None and digest_every_turns > 0
+                    and done_turn > start_turn
+                    and done_turn % digest_every_turns == 0):
+                # Journal digest at an exact cadence boundary (every
+                # such boundary is a chunk boundary — the issue-side
+                # k_cap lands chunks on digest turns). This chunk is
+                # already complete, so the device_get is a small copy,
+                # not a pipeline drain, and the sha256 + append overlap
+                # with the chunks still computing on the device.
+                try:
+                    _dhost = np.asarray(jax.device_get(done_cells))
+                    if pad_rows:
+                        _dhost = _dhost[
+                            ..., : _dhost.shape[-2] - pad_rows, :]
+                    journal_writer.digest(
+                        done_turn,
+                        journal_mod.board_digest(_dhost, repr_),
+                        repr_=repr_)
+                except Exception:
+                    # A failed digest must never sink the run; the
+                    # journal sink latches itself dead on OSError.
+                    pass
             if now - last_devpoll >= 2.0:
                 # Throttled gol_dev_* refresh: memory_stats() is a cheap
                 # local counter read, but once per chunk at µs chunk
@@ -1166,6 +1230,12 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                     # an interrupted+resumed run's checkpoints comparable
                     # turn-for-turn against an uninterrupted one.
                     k_cap = min(k_cap, next_ckpt_turn - self._turn)
+                if next_digest_turn is not None:
+                    # Same exactness contract as checkpoint turns: a
+                    # digest event's turn is a pure function of
+                    # (start_turn, cadence), so the replay auditor can
+                    # advance exactly that many turns and compare.
+                    k_cap = min(k_cap, next_digest_turn - self._turn)
                 k = _next_chunk(chunk, k_cap)
                 # Trace the second chunk (first is compile-warmup), or the
                 # first when it is the whole run; the traced result is kept
@@ -1247,6 +1317,19 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                     next_ckpt_turn = (
                         self._turn // ckpt_every_turns + 1
                     ) * ckpt_every_turns
+                if (next_digest_turn is not None
+                        and self._turn >= next_digest_turn):
+                    # Only the cadence pointer advances here — it must,
+                    # or the next issue's k_cap above would collapse to
+                    # zero. The digest itself happens at POP time
+                    # (`_pop_oldest`), on the already-completed chunk's
+                    # cells: digesting the frontier would device_get an
+                    # in-flight array and drain the whole pipeline per
+                    # digest (measured ~7% wall at a 256-turn cadence
+                    # on a fast 512² host vs ~0% overlapped).
+                    next_digest_turn = (
+                        self._turn // digest_every_turns + 1
+                    ) * digest_every_turns
                 if ckpt_path and \
                         time.monotonic() - last_ckpt >= ckpt_every:
                     t_sync = time.monotonic()
@@ -1357,6 +1440,13 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                 # the engine forever (the daemon thread finishes or
                 # dies with the process).
                 ckpt_writer.close(timeout=60.0)
+            if journal_writer is not None:
+                # After the ckpt drain, so the final checkpoint's digest
+                # event precedes the end bookend in the chain.
+                try:
+                    journal_writer.append("end", turn=final_turn)
+                except Exception:
+                    pass
             # Run-end flush: the batched counters/histograms land before
             # anyone can observe the run as finished, so post-run totals
             # are exact (test_obs counts on this).

@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from gol_tpu import journal as journal_mod
 from gol_tpu.engine import (
     CKPT_ENV,
     ControlFlagProtocol,
@@ -317,6 +318,7 @@ class FleetEngine(ControlFlagProtocol):
             raise RuntimeError(
                 "admission rejected: shape (board sides must divide a "
                 f"bucket class {self.bucket_sizes})")
+        derived = board is None
         if board is None:
             board = _soup(run_id, h, w)
         board01 = self._board01(board, h, w)
@@ -339,6 +341,7 @@ class FleetEngine(ControlFlagProtocol):
                         handle.enqueued_s = time.monotonic()
                         self._runs[run_id] = handle
                         self._waitq.append(handle)
+                        self._journal_create(handle, board01, derived)
                         self._wake.notify_all()
                         return handle.describe()
                     reason = qreason
@@ -346,6 +349,7 @@ class FleetEngine(ControlFlagProtocol):
                 raise RuntimeError(f"admission rejected: {reason}")
             self._runs[run_id] = handle
             self._placeq.append(handle)
+            self._journal_create(handle, board01, derived)
             self._wake.notify_all()
         self._ensure_loop()
         if wait:
@@ -465,6 +469,14 @@ class FleetEngine(ControlFlagProtocol):
             self._wake.notify_all()
         obs_log("fleet.adopt", run_id=rid, turn=handle.turn,
                 rule=run_rule.rulestring, board=f"{h_}x{w_}")
+        # Lineage link: if the predecessor journaled, reference its
+        # chain head so verify_segments can stitch across the failover
+        # (the manifest's journal stamp is the durable carrier).
+        jstamp = m.get("journal") or {}
+        self._journal_event(
+            rid, "link", turn=int(m["turn"]),
+            prev_head=jstamp.get("head"), prev_seq=jstamp.get("seq"),
+            reason="adopt")
         self._ensure_loop()
         return handle.describe()
 
@@ -514,6 +526,7 @@ class FleetEngine(ControlFlagProtocol):
                     f"run {rid} has no board to transfer")
             h.migrating = prior
             self._wake.notify_all()
+            jw = journal_mod.get(rid)
             return {
                 "run_id": rid,
                 "board": h.frozen.copy(),
@@ -523,6 +536,10 @@ class FleetEngine(ControlFlagProtocol):
                 "ckpt_every": int(h.ckpt_every),
                 "target_turn": h.target_turn,
                 "state": prior,
+                # Chain head rides the transfer so the target's link
+                # event can reference this segment's lineage.
+                "journal_head": (jw.head_info() if jw is not None
+                                 else None),
             }
 
     def migrate_checkpoint(self, run_id: str,
@@ -556,7 +573,12 @@ class FleetEngine(ControlFlagProtocol):
                 except queue_mod.Empty:
                     break
             h.migrating = None
-            self._remove_locked(h)
+            # The run lives on elsewhere: bookend this segment with
+            # migrate_out (not "end") so the stitched lineage reads as
+            # a handoff, not a termination.
+            self._journal_event(h.run_id, "migrate_out",
+                                turn=int(h.turn))
+            self._remove_locked(h, journal_end=False)
             self._wake.notify_all()
         return flags
 
@@ -591,7 +613,8 @@ class FleetEngine(ControlFlagProtocol):
     def import_run(self, run_id: str, board: np.ndarray, turn: int,
                    rule=None, ckpt_every: int = 0,
                    target_turn: Optional[int] = None,
-                   activate: bool = True) -> dict:
+                   activate: bool = True,
+                   journal_head: Optional[dict] = None) -> dict:
         """TARGET half of the transfer: stage a migrated-in run. The
         board is admitted and registered parked+hidden ("staged") —
         invisible to list_runs and never auto-resumed — until CommitRun
@@ -640,6 +663,11 @@ class FleetEngine(ControlFlagProtocol):
             self._runs[rid] = handle
         obs_log("fleet.import", run_id=rid, turn=handle.turn,
                 rule=run_rule.rulestring, board=f"{h_}x{w_}")
+        jstamp = journal_head or {}
+        self._journal_event(
+            rid, "link", turn=int(turn),
+            prev_head=jstamp.get("head"), prev_seq=jstamp.get("seq"),
+            reason="migrate")
         return handle.describe()
 
     def activate_imported(self, run_id: str) -> dict:
@@ -711,6 +739,8 @@ class FleetEngine(ControlFlagProtocol):
             if h.rule.rulestring != new_rule.rulestring:
                 self._migrate_rule_locked(h, new_rule)
                 obs.RUNS_RULE_MIGRATIONS.inc()
+                self._journal_event(rid, "rule", turn=int(h.turn),
+                                    rule=new_rule.rulestring)
             rec = h.describe()
             self._wake.notify_all()
         self._ensure_loop()
@@ -1146,6 +1176,73 @@ class FleetEngine(ControlFlagProtocol):
                               ckpt_mod.CKPT_KEEP_DEFAULT),
             keep_every=env_int(ckpt_mod.CKPT_KEEP_EVERY_ENV, 0,
                                minimum=0))
+
+    # ------------------------------------------ run journal (PR 17)
+    #
+    # Every state-mutating input to a fleet run is appended to its
+    # hash-chained gol-journal/1 log (gol_tpu/journal.py). Board
+    # digests ride the existing bounded checkpoint-writer pool: the
+    # CheckpointWriter appends a digest event from the packed payload
+    # it already hashes for the manifest, so journaling adds no device
+    # readbacks of its own. All hooks are best-effort — observability
+    # must never sink a run.
+
+    def _journal_event(self, run_id: str, kind: str, **fields) -> None:
+        """Append one event to `run_id`'s journal; never raises."""
+        try:
+            jw = journal_mod.for_run(run_id)
+            if jw is not None:
+                jw.append(kind, **fields)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _journal_board_sha(board01: np.ndarray) -> dict:
+        """Digest fields for a {0,1} board, using the SAME payload
+        representation _snapshot_locked would checkpoint it as (packed
+        words when word-aligned, u8 otherwise) so journal digests and
+        manifest board_sha256 values agree for the same turn."""
+        if board01.shape[-1] % WORD_BITS == 0:
+            words = np.ascontiguousarray(board_to_words(board01))
+            return {"board_sha256": journal_mod.board_digest(
+                words, "packed"), "repr": "packed"}
+        return {"board_sha256": journal_mod.board_digest(
+            board01, "u8"), "repr": "u8"}
+
+    @staticmethod
+    def _journal_seed(board01: np.ndarray, derived: bool) -> dict:
+        """Seed provenance for create/reseed events. Derived soups are
+        replayable from the run_id alone (seed_kind "soup"); small
+        explicit boards ride inline; big ones only by digest."""
+        fields = FleetEngine._journal_board_sha(board01)
+        if derived:
+            fields["seed_kind"] = "soup"
+            return fields
+        if board01.size <= (1 << 22):
+            seed = journal_mod.encode_board(board01)
+            if seed is not None:
+                fields["seed_kind"] = "inline"
+                fields["seed"] = seed
+                return fields
+        fields["seed_kind"] = "external"
+        return fields
+
+    def _journal_create(self, h: RunHandle, board01: np.ndarray,
+                        derived: bool) -> None:
+        """Journal a run's birth (called under the fleet lock, right
+        after registration — the loop can't have submitted any pool
+        digest for it yet, so create is always seq-first)."""
+        if not journal_mod.enabled():
+            return
+        try:
+            fields = self._journal_seed(board01, derived)
+            fields.update(turn=int(h.turn), h=int(h.h), w=int(h.w),
+                          rule=h.rule.rulestring)
+            if h.target_turn is not None:
+                fields["target_turn"] = int(h.target_turn)
+            self._journal_event(h.run_id, "create", **fields)
+        except Exception:
+            pass
 
     def restore_run(self, path: str, reshard: bool = False) -> int:
         from gol_tpu import ckpt as ckpt_mod
@@ -1694,6 +1791,13 @@ class FleetEngine(ControlFlagProtocol):
             with self._state_lock:
                 self._turn = h.turn
                 self._alive_pub = (h.alive, h.turn)
+        if journal_mod.enabled():
+            try:
+                fields = self._journal_seed(board01, False)
+                fields["turn"] = h.turn
+                self._journal_event(h.run_id, "reseed", **fields)
+            except Exception:
+                pass
 
     def _service_flags_locked(self, h: RunHandle) -> None:
         # Flags arriving mid-migration are DEFERRED, not dropped: the
@@ -1729,6 +1833,9 @@ class FleetEngine(ControlFlagProtocol):
                 h.paused = False
         else:
             h.paused = not h.paused
+        self._journal_event(h.run_id,
+                            "pause" if h.paused else "resume",
+                            turn=int(h.turn))
 
     def _park_locked(self, bucket: Bucket, h: RunHandle) -> None:
         """Freeze a resident run: its board copies to the handle, the
@@ -1863,6 +1970,14 @@ class FleetEngine(ControlFlagProtocol):
         obs_log("fleet.quarantine_restored", run_id=h.run_id,
                 turn=h.turn, attempt=h.quarantine_tries,
                 reason=h.quarantine_reason)
+        if journal_mod.enabled():
+            try:
+                fields = self._journal_board_sha(board01)
+                fields.update(turn=h.turn, reason=h.quarantine_reason,
+                              attempt=h.quarantine_tries)
+                self._journal_event(h.run_id, "restore", **fields)
+            except Exception:
+                pass
 
     def _load_run_ckpt(self, h: RunHandle) -> Tuple[np.ndarray, int]:
         """(board01, turn) from the run's newest durable checkpoint,
@@ -1896,10 +2011,14 @@ class FleetEngine(ControlFlagProtocol):
                 f"run {(h.h, h.w)}")
         return np.ascontiguousarray(board01), turn
 
-    def _remove_locked(self, h: RunHandle) -> None:
+    def _remove_locked(self, h: RunHandle,
+                       journal_end: bool = True) -> None:
         """Terminal: free the slot, return the admission charge, drop
         the handle from the registry. The final board stays on
-        `h.frozen` so an in-flight _drive can still return it."""
+        `h.frozen` so an in-flight _drive can still return it.
+        `journal_end=False` suppresses the journal's "end" bookend —
+        migrate_commit writes "migrate_out" instead (the run lives on
+        elsewhere)."""
         if h in self._placeq:
             self._placeq.remove(h)
         if h in self._waitq:
@@ -1921,6 +2040,9 @@ class FleetEngine(ControlFlagProtocol):
             # the per-run writer had); only the directory core is
             # dropped so the pool's map cannot grow unboundedly.
             self._ckpt_pool.forget(h.run_id)
+        if journal_end:
+            self._journal_event(h.run_id, "end", turn=int(h.turn))
+        journal_mod.forget(h.run_id)
         self._runs.pop(h.run_id, None)
         h.done.set()
 

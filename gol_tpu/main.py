@@ -50,6 +50,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="append a JSON-lines chunk-timeline run report "
                          "(schema gol-run-report/1) to PATH; equivalent "
                          "to GOL_RUN_REPORT=PATH")
+    ap.add_argument("--journal", metavar="DIR", default="",
+                    help="append every state-mutating run input to a "
+                         "hash-chained gol-journal/1 log under DIR "
+                         "(replay with tools/replay_audit.py); "
+                         "equivalent to GOL_JOURNAL=DIR")
+    ap.add_argument("--journal-digest-every", type=int, default=0,
+                    metavar="TURNS",
+                    help="journal a canonical board digest every TURNS "
+                         "turns at chunk boundaries (sets "
+                         "GOL_JOURNAL_DIGEST_EVERY; default 512; "
+                         "requires --journal)")
     ap.add_argument("--metrics-port", type=int, default=None,
                     metavar="PORT",
                     help="serve Prometheus text on "
@@ -192,6 +203,15 @@ def main(argv=None) -> int:
         from gol_tpu.obs.timeline import RUN_REPORT_ENV
 
         os.environ[RUN_REPORT_ENV] = args.run_report
+    if args.journal:
+        from gol_tpu import journal as journal_mod
+
+        os.environ[journal_mod.JOURNAL_ENV] = args.journal
+    if args.journal_digest_every:
+        from gol_tpu import journal as journal_mod
+
+        os.environ[journal_mod.DIGEST_EVERY_ENV] = str(
+            args.journal_digest_every)
     # Checkpoint knobs travel as env too — the engine reads them at run
     # start (gol_tpu/ckpt package docstring has the full table).
     if args.checkpoint:

@@ -221,6 +221,8 @@ def rescale(server, run_id: str, target: str) -> dict:
                 }
                 if quiesced["target_turn"] is not None:
                     header["target_turn"] = quiesced["target_turn"]
+                if quiesced.get("journal_head"):
+                    header["journal_head"] = quiesced["journal_head"]
                 _rpc(target, header, frame=frame, timeout=remaining())
                 staged_on_target = True
             # -- resume -------------------------------------------------

@@ -21,7 +21,7 @@ WIRE_METHODS = (
     "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "SetRule",
     "RegisterMember", "AdoptRun", "Subscribe",
     "Rescale", "ReceiveRun", "CommitRun", "PinRun",
-    "GetTelemetry", "GetAudit",
+    "GetTelemetry", "GetAudit", "GetJournal",
     "unknown",
 )
 
@@ -595,6 +595,45 @@ AUDIT_RECORDS = REGISTRY.counter(
     label_names=("kind",))
 for _k in AUDIT_KINDS:
     AUDIT_RECORDS.labels(kind=_k)
+
+# Event-sourced run journal (gol_tpu/journal.py): the gol-journal/1
+# hash-chained black box. Kinds mirror journal.KINDS — a closed set so
+# an arbitrary append can't mint unbounded label values.
+JOURNAL_KINDS = ("create", "rule", "reseed", "pause", "resume", "fuse",
+                 "link", "restore", "digest", "migrate_out", "end",
+                 "other")
+JOURNAL_EVENTS = REGISTRY.counter(
+    "gol_journal_events_total",
+    "gol-journal/1 records appended to per-run hash-chained journals "
+    "(GOL_JOURNAL), by event kind.",
+    label_names=("kind",))
+for _k in JOURNAL_KINDS:
+    JOURNAL_EVENTS.labels(kind=_k)
+JOURNAL_BYTES = REGISTRY.counter(
+    "gol_journal_bytes_total",
+    "Bytes appended to journal files, newline included — the black "
+    "box's disk footprint rate.")
+JOURNAL_WALL_US = REGISTRY.counter(
+    "gol_journal_wall_us_total",
+    "Host wall microseconds spent inside the journal hot path — "
+    "canonical board digests, inline seed encodes, and hash-chained "
+    "appends. The bench.py --journal leg gates this as a share of run "
+    "wall (journal_overhead_pct), the same in-process cost-accounting "
+    "pattern as telemetry_overhead_pct: a direct measure that cannot "
+    "flap with host contention the way differential wall clock does.")
+JOURNAL_DIGESTS = REGISTRY.counter(
+    "gol_journal_digests_total",
+    "Board-digest events journaled (engine chunk-boundary cadence via "
+    "GOL_JOURNAL_DIGEST_EVERY plus every checkpoint written while "
+    "journaling is on) — each one is a mid-history bit-identity "
+    "assertion a replay can check.")
+REPLAY_DIVERGENCE = REGISTRY.counter(
+    "gol_replay_divergence_total",
+    "Digest events at which a tools/replay_audit.py replay disagreed "
+    "with the recorded journal. Stays 0 for a healthy deterministic "
+    "engine; any increment means the recorded history and the engine "
+    "no longer agree and the auditor has bisected to the first "
+    "divergent turn.")
 
 
 # ------------------------------------------- live migration & resharding

@@ -1,0 +1,89 @@
+"""Shared "observability must never sink a run" sink guard.
+
+Every durable observability writer in this repo — the run reporter
+(`obs/timeline.py`), the span exporter (`obs/trace.py`), and the run
+journal (`gol_tpu/journal.py`) — has the same failure contract: the
+first OSError (disk full, bad path, permission) permanently disables
+the sink and the engine carries on unmetered. Before this module each
+writer carried its own copy of that guard; now they share one.
+
+`GuardedLineSink` is the append-only line writer: lazy open on first
+write, write+flush under a lock, and `dead` latched forever after the
+first OSError. `guarded_export` is the one-shot variant for exporters
+that dump a whole artifact at close (the Chrome trace): call the thunk,
+swallow OSError/ValueError, never raise into engine teardown.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import IO, Callable, Optional
+
+__all__ = ["GuardedLineSink", "guarded_export"]
+
+
+class GuardedLineSink:
+    """Append-only line sink that disables itself after one OSError.
+
+    Thread-safe; the file is opened lazily on the first `write_line`
+    so constructing a sink for a bad path costs nothing until used.
+    Once `dead`, every subsequent write is a silent no-op — the guard
+    never un-latches (a sink that half-recovers would interleave holes
+    into append-only logs, which is worse than stopping cleanly).
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh: Optional[IO[str]] = None
+        self._dead = False
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def write_line(self, line: str) -> bool:
+        """Append `line` + newline and flush. True iff it hit the file;
+        False once the sink is dead (including the write that kills it).
+        """
+        with self._lock:
+            if self._dead:
+                return False
+            try:
+                if self._fh is None:
+                    self._fh = open(self.path, "a", encoding="utf-8")
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                return True
+            except OSError:
+                self._kill_locked()
+                return False
+
+    def close(self) -> None:
+        """Close the file and latch dead (idempotent)."""
+        with self._lock:
+            self._kill_locked()
+
+    def _kill_locked(self) -> None:
+        self._dead = True
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+def guarded_export(thunk: Callable[[], None]) -> bool:
+    """Run a one-shot export thunk; swallow sink errors.
+
+    For end-of-run exporters (Chrome trace dump) where there is no
+    stream to disable — the whole artifact either lands or it doesn't.
+    Returns True on success, False if the export failed; never raises
+    into engine teardown (these run on shutdown paths).
+    """
+    try:
+        thunk()
+        return True
+    except Exception:
+        return False

@@ -21,18 +21,19 @@ Schema `gol-run-report/1` — every record is one JSON object per line:
     bench_leg    value (+ metric/unit/vs_baseline/detail — bench.py's
                  --self-report mirror of its stdout BENCH lines)
 
-Reporter failures (disk full, bad path) must never sink a run: after
-the first OSError the reporter disables itself and the engine carries
-on unmetered.
+Reporter failures (disk full, bad path) must never sink a run: the
+shared `obs.sink.GuardedLineSink` disables the reporter after the
+first OSError and the engine carries on unmetered.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import threading
 import time
-from typing import IO, Iterator, Optional
+from typing import Iterator, Optional
+
+from gol_tpu.obs.sink import GuardedLineSink
 
 SCHEMA = "gol-run-report/1"
 RUN_REPORT_ENV = "GOL_RUN_REPORT"
@@ -53,41 +54,18 @@ class RunReporter:
         self.path = path
         self.run_id = run_id or f"run-{os.getpid()}-{int(time.time())}"
         self._t0 = time.monotonic()
-        self._lock = threading.Lock()
-        self._fh: Optional[IO[str]] = None
-        self._dead = False
+        self._sink = GuardedLineSink(path)
 
     def emit(self, event: str, **fields) -> None:
+        if self._sink.dead:
+            return
         rec = {"schema": SCHEMA, "event": event, "run_id": self.run_id,
                "t": round(time.monotonic() - self._t0, 6)}
         rec.update(fields)
-        line = json.dumps(rec, sort_keys=True)
-        with self._lock:
-            if self._dead:
-                return
-            try:
-                if self._fh is None:
-                    self._fh = open(self.path, "a", encoding="utf-8")
-                self._fh.write(line + "\n")
-                self._fh.flush()
-            except OSError:
-                self._dead = True
-                try:
-                    if self._fh is not None:
-                        self._fh.close()
-                except OSError:
-                    pass
-                self._fh = None
+        self._sink.write_line(json.dumps(rec, sort_keys=True))
 
     def close(self) -> None:
-        with self._lock:
-            if self._fh is not None:
-                try:
-                    self._fh.close()
-                except OSError:
-                    pass
-                self._fh = None
-            self._dead = True
+        self._sink.close()
 
 
 def from_env(environ=os.environ) -> Optional[RunReporter]:

@@ -339,14 +339,16 @@ def hot_spans_enabled() -> bool:
 
 def export_from_env() -> Optional[str]:
     """Export to `GOL_TRACE_SPANS` if set (what `--trace-spans` sets);
-    never raises — this runs on shutdown paths."""
+    never raises — the shared obs.sink guard absorbs sink failures on
+    shutdown paths."""
+    from gol_tpu.obs.sink import guarded_export
+
     path = os.environ.get(TRACE_SPANS_ENV, "").strip()
     if not path:
         return None
-    try:
-        return TRACER.export_chrome(path)
-    except Exception:
-        return None
+    out: List[str] = []
+    ok = guarded_export(lambda: out.append(TRACER.export_chrome(path)))
+    return out[0] if ok and out else None
 
 
 def validate_chrome(doc: dict) -> None:

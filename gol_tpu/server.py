@@ -553,6 +553,26 @@ class EngineServer:
                     "records": obs_audit.recent(
                         int(header.get("since_seq", 0) or 0),
                         int(header.get("limit", 100) or 100))})
+            elif method == "GetJournal":
+                # Hash-chained run journal tail (PR 17). Resolved by
+                # run_id directly (not RUN_SCOPED — the journal registry
+                # is process-global, keyed by run id, and outlives the
+                # run handle so a retired run's black box stays
+                # readable until process exit).
+                from gol_tpu import journal as journal_mod
+                rid = str(header.get("run_id") or "")
+                jw = journal_mod.get(rid) if rid else None
+                if jw is None:
+                    raise KeyError(f"no journal for run {rid!r}")
+                self._reply(conn, {
+                    "ok": True,
+                    "head": jw.head, "seq": jw.last_seq,
+                    "path": journal_mod.journal_path(rid),
+                    "records": jw.tail(
+                        int(header.get("since_seq", -1)
+                            if header.get("since_seq") is not None
+                            else -1),
+                        int(header.get("limit", 100) or 100))})
             elif method == "Alivecount":
                 alive, turn = eng.alive_count()
                 self._reply(conn,
@@ -751,7 +771,8 @@ class EngineServer:
                     ckpt_every=int(header.get("ckpt_every", 0) or 0),
                     target_turn=int(tt) if tt is not None else None,
                     activate=str(header.get("state", "resident"))
-                    in ("resident", "queued"))
+                    in ("resident", "queued"),
+                    journal_head=header.get("journal_head"))
                 self._reply(conn, {"ok": True, "run": rec})
             elif method == "CommitRun":
                 act = getattr(self.engine, "activate_imported", None)
@@ -913,6 +934,18 @@ def main() -> None:
                          "(sets GOL_CKPT_KEEP; default 3; "
                          "GOL_CKPT_KEEP_EVERY additionally pins every "
                          "K-th turn)")
+    ap.add_argument("--journal", metavar="DIR", default="",
+                    help="event-sourced run journal root (sets "
+                         "GOL_JOURNAL): every state-mutating input per "
+                         "run appends to a hash-chained gol-journal/1 "
+                         "JSONL log replayable by tools/replay_audit.py")
+    ap.add_argument("--journal-digest-every", metavar="TURNS", type=int,
+                    default=0,
+                    help="board-digest journal events every TURNS on "
+                         "the single-run engine (sets "
+                         "GOL_JOURNAL_DIGEST_EVERY; default 512; fleet "
+                         "runs digest at their checkpoint cadence "
+                         "instead, via the shared writer pool)")
     ap.add_argument("--profile-dir", metavar="DIR", default="",
                     help="directory for on-demand jax.profiler captures "
                          "(Profile wire method / POST /profile arm one; "
@@ -970,6 +1003,11 @@ def main() -> None:
         os.environ["GOL_CKPT_EVERY_TURNS"] = str(args.ckpt_every)
     if args.ckpt_keep:
         os.environ["GOL_CKPT_KEEP"] = str(args.ckpt_keep)
+    if args.journal:
+        os.environ["GOL_JOURNAL"] = args.journal
+    if args.journal_digest_every:
+        os.environ["GOL_JOURNAL_DIGEST_EVERY"] = str(
+            args.journal_digest_every)
     if args.profile_dir:
         # configure() only: the server arms nothing at startup — a
         # Profile RPC or POST /profile picks the moment and turn count.

@@ -9,7 +9,10 @@ Polls a federation router's GetTelemetry / GetAudit wire methods (PR
   * active alerts (rule + how long they have been firing);
   * the tail of the gol-fleet-audit/1 log (newest last), streamed
     incrementally by `since_seq` so each frame only fetches records
-    it has not seen.
+    it has not seen;
+  * with `--journal-run RUN_ID`, that run's hash-chained gol-journal/1
+    tail (GetJournal, proxied by the router to the run's owner) — the
+    black box pane: chain head, last seq, newest events.
 
     python tools/fleet_top.py --router HOST:PORT            # live
     python tools/fleet_top.py --router HOST:PORT --once     # one frame
@@ -41,9 +44,11 @@ def _si(v: float) -> str:
     return f"{v:.0f}"
 
 
-def render(doc: dict, records: list, now: float = None) -> str:
-    """One dashboard frame from a GetTelemetry doc and an audit tail
-    (oldest first). Pure string building — no I/O, no client."""
+def render(doc: dict, records: list, now: float = None,
+           journal: dict = None) -> str:
+    """One dashboard frame from a GetTelemetry doc, an audit tail
+    (oldest first), and optionally one run's GetJournal tail. Pure
+    string building — no I/O, no client."""
     if now is None:
         now = time.time()
     fleet = doc.get("fleet", {})
@@ -106,6 +111,27 @@ def render(doc: dict, records: list, now: float = None) -> str:
                      f"{rec.get('kind', '?'):<16} {extra}")
     if not records:
         lines.append("  (empty)")
+
+    if journal is not None:
+        lines.append("")
+        head = str(journal.get("head") or "")[:16]
+        lines.append(
+            f"journal {journal.get('run_id', '?')}  "
+            f"seq={journal.get('seq', -1)}  head={head}…")
+        for rec in journal.get("records", [])[-10:]:
+            extra = " ".join(
+                f"{k}={rec[k]}" for k in
+                ("turn", "rule", "seed_kind", "reason", "repr")
+                if k in rec)
+            sha = str(rec.get("board_sha256", ""))[:10]
+            if sha:
+                extra = f"{extra} sha={sha}…" if extra else f"sha={sha}…"
+            lines.append(f"  #{rec.get('seq', '?'):>4} "
+                         f"{rec.get('kind', '?'):<12} {extra}")
+        if journal.get("error"):
+            lines.append(f"  (journal unavailable: {journal['error']})")
+        elif not journal.get("records"):
+            lines.append("  (no journal records)")
     return "\n".join(lines)
 
 
@@ -114,6 +140,21 @@ def fetch_frame(client: RemoteEngine, since_seq: int) -> tuple:
     doc = client.get_telemetry()
     records = client.get_audit(since_seq=since_seq, limit=200)
     return doc, records
+
+
+def fetch_journal(router: str, run_id: str,
+                  timeout: float = 10.0) -> dict:
+    """One run's journal tail via the router (a run-scoped client so
+    the run_id header routes GetJournal to the owning member). Errors
+    render in-pane instead of killing the dashboard."""
+    try:
+        cli = RemoteEngine(router, timeout=timeout, run_id=run_id)
+        j = cli.get_journal(limit=50)
+        j["run_id"] = run_id
+        return j
+    except Exception as e:
+        return {"run_id": run_id, "head": "", "seq": -1, "records": [],
+                "error": f"{type(e).__name__}: {e}"}
 
 
 def main(argv=None) -> int:
@@ -125,6 +166,9 @@ def main(argv=None) -> int:
                     help="refresh period, seconds (default 2)")
     ap.add_argument("--once", action="store_true",
                     help="print a single frame and exit (CI mode)")
+    ap.add_argument("--journal-run", default="", metavar="RUN_ID",
+                    help="also render RUN_ID's gol-journal/1 tail "
+                         "(GetJournal via the router)")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
@@ -137,7 +181,10 @@ def main(argv=None) -> int:
             for rec in fresh:
                 seen_seq = max(seen_seq, int(rec.get("seq", 0)))
             tail = (tail + fresh)[-200:]
-            frame = render(doc, tail)
+            jrn = (fetch_journal(args.router, args.journal_run,
+                                 timeout=args.timeout)
+                   if args.journal_run else None)
+            frame = render(doc, tail, journal=jrn)
             if args.once:
                 print(frame)
                 return 0

@@ -69,7 +69,7 @@ DEFAULT_GATE_PATTERN = (
     r"|halo (?:bytes|exchanges)/turn"
     r"|encode_calls_per_published_frame|viewer_fanout_p\d+_ms"
     r"|telemetry_overhead_pct|heartbeat_payload_p\d+_bytes"
-    r"|alert_detection_p\d+_ms")
+    r"|alert_detection_p\d+_ms|journal_overhead_pct")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
