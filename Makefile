@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke usage-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -186,8 +186,24 @@ journal-smoke:
 	python tools/perf_compare.py BASELINE.json out/journal_smoke.jsonl
 	JAX_PLATFORMS=cpu python tools/journal_smoke.py
 
+# Per-run usage metering & capacity attribution (PR 19): bench.py
+# --usage gates usage_overhead_pct <= 2% (meter wall share of the
+# dispatch window) and usage_attribution_error_pct <= 1% (sum of
+# per-run device-time shares vs measured dispatch wall), then checks
+# the capacity headroom forecast against an admit-to-rejection count
+# (+-10%). tools/usage_smoke.py proves the plane end to end: GetUsage
+# over a real socket, /healthz usage doc, top-talker ranking,
+# fleet_top --usage rendering, and the final journal usage record on
+# DestroyRun.
+usage-smoke:
+	mkdir -p out
+	set -e; JAX_PLATFORMS=cpu python bench.py --usage \
+		| tee out/usage_smoke.jsonl
+	python tools/perf_compare.py BASELINE.json out/usage_smoke.jsonl
+	JAX_PLATFORMS=cpu python tools/usage_smoke.py
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke usage-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -1358,6 +1358,184 @@ def bench_journal(turns: int = 0) -> int:
     return 0
 
 
+# --usage leg sizing (PR 19): enough resident runs that apportionment
+# is non-trivial, one free-running window long enough for several
+# metric flushes (the meter only moves at the 0.5 s batched cadence).
+USAGE_RUNS = 8
+USAGE_WINDOW_S = 2.0
+USAGE_FORECAST_TOL_PCT = 10.0
+
+
+def bench_usage(window_s: float = USAGE_WINDOW_S) -> int:
+    """Per-run usage metering cost + attribution + headroom (PR 19).
+
+    Leg 1: USAGE_RUNS resident 512² runs free-run for a wall window.
+    Gated numbers: usage_overhead_pct — gol_usage_wall_us_total
+    (every instruction the meter executes, self-timed in-process) as
+    a share of the window wall, the same contention-immune accounting
+    as journal_overhead_pct — and usage_attribution_error_pct —
+    |Σ per-run device-time shares − measured dispatch wall| as a
+    percentage of that wall, read from the meter's conservation
+    ledger. The PR-6 zero-work witnesses (wire encodes, band copies)
+    must not move: metering rides the batched flush, never the hot
+    path. Hard-fails when no dispatch wall was attributed.
+
+    Leg 2: headroom forecast. A fresh engine under a small explicit
+    GOL_FLEET_MEM_BUDGET publishes its projected admissible-run count,
+    then runs are admitted to rejection — the landing must be within
+    ±10% of the projection."""
+    import os
+
+    from gol_tpu.fleet import FleetEngine
+    from gol_tpu.fleet.admission import run_cost
+    from gol_tpu.obs import catalog as obs_cat
+    from gol_tpu.obs import usage as obs_usage
+    from gol_tpu.ops.bitpack import WORD_BITS
+
+    n, count = 512, USAGE_RUNS
+    knobs = ("GOL_CKPT", "GOL_CKPT_EVERY_TURNS", "GOL_RULE",
+             "GOL_FLEET_BUCKETS", "GOL_FLEET_CHUNK",
+             "GOL_FLEET_SLOT_BASE", "GOL_FLEET_MEM_BUDGET",
+             "GOL_FLEET_MESH_DEVICES", "GOL_FLEET_MIN_SLOTS_PER_DEV",
+             "GOL_USAGE_FLUSH_S", "GOL_USAGE_TOPK", "GOL_JOURNAL")
+    saved = {v: os.environ.get(v) for v in knobs}
+    rc = 0
+    rng = np.random.default_rng(7)
+    try:
+        for v in knobs:
+            os.environ.pop(v, None)
+        # Rebuild the usage doc on every read: the leg inspects the
+        # conservation ledger right after the final engine flush.
+        os.environ["GOL_USAGE_FLUSH_S"] = "0"
+        obs_usage.METER.reset()
+
+        eng = FleetEngine(bucket_sizes=(n,), slot_base=max(8, count))
+        try:
+            for i in range(count):
+                seed = (rng.random((n, n)) < 0.25).astype(np.uint8)
+                eng.create_run(n, n, board=seed, run_id=f"u{i}",
+                               wait=False)
+            deadline = time.monotonic() + 120
+            while eng.runs_summary()["resident"] < count:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("usage leg placement timed out")
+                time.sleep(0.05)
+            warm0 = eng.throughput_counters()["board_turns"]
+            while eng.throughput_counters()["board_turns"] == warm0:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("usage leg never dispatched")
+                time.sleep(0.05)
+            enc0 = obs_cat.WIRE_ENCODE_CALLS.value
+            band0 = obs_cat.ENGINE_BAND_COPIES.value
+            uwall0 = obs_cat.USAGE_WALL_US.value
+            t0 = time.perf_counter()
+            time.sleep(window_s)
+            elapsed = time.perf_counter() - t0
+            uwall_s = (obs_cat.USAGE_WALL_US.value - uwall0) / 1e6
+            wire_calls = int(obs_cat.WIRE_ENCODE_CALLS.value - enc0)
+            band_copies = int(obs_cat.ENGINE_BAND_COPIES.value - band0)
+            overhead_us = eng.throughput_counters()["chunk_overhead_us"]
+        finally:
+            eng.kill_prog()
+        doc = obs_usage.usage_doc()
+        att = doc.get("attribution", {})
+        wall_s = float(att.get("wall_s", 0.0))
+        err_pct = float(att.get("error_pct", 0.0))
+        pct = uwall_s / elapsed * 100.0 if elapsed > 0 else 0.0
+        _emit("usage_overhead_pct", round(pct, 3), "%", None,
+              {"runs": count, "size": n, "window_s": round(elapsed, 4),
+               "usage_wall_s": round(uwall_s, 6),
+               "runs_tracked": doc.get("runs_tracked", 0),
+               "chunk_overhead_us": overhead_us,
+               "wire_encode_calls": wire_calls,
+               "band_copies": band_copies,
+               "method": "in-process gol_usage_wall_us_total share of "
+                         "the free-running window wall (dispatch "
+                         "apportionment + charge updates + doc "
+                         "rebuilds); same accounting pattern as "
+                         "journal_overhead_pct"})
+        _emit("usage_attribution_error_pct", round(err_pct, 4), "%",
+              None,
+              {"runs": count, "size": n,
+               "attributed_s": att.get("attributed_s", 0.0),
+               "wall_s": att.get("wall_s", 0.0),
+               "method": "|sum of per-run device-time shares - "
+                         "measured fleet dispatch wall| / wall; "
+                         "spatial dispatches charge each active run "
+                         "the full quantum and scale the wall "
+                         "denominator to match"})
+        if wall_s <= 0:
+            print("BENCH LEG FAILED (usage): no dispatch wall was "
+                  "attributed — overhead/attribution numbers are "
+                  "meaningless", file=sys.stderr)
+            rc |= 1
+        if wire_calls or band_copies:
+            print(f"BENCH LEG FAILED (usage): zero-work witnesses "
+                  f"moved with no viewers attached "
+                  f"(wire_encode_calls={wire_calls}, "
+                  f"band_copies={band_copies})", file=sys.stderr)
+            rc |= 1
+
+        # Leg 2: capacity headroom forecast vs admit-to-rejection.
+        obs_usage.METER.reset()
+        wpb = (n + WORD_BITS - 1) // WORD_BITS
+        cost = run_cost(n, wpb)
+        # One seeded run + 6.5 run-costs of free budget: the model
+        # must project exactly 6 more admissible runs.
+        os.environ["GOL_FLEET_MEM_BUDGET"] = str(cost * 7 + cost // 2)
+        eng2 = FleetEngine(bucket_sizes=(n,), slot_base=8)
+        try:
+            seed = (rng.random((n, n)) < 0.25).astype(np.uint8)
+            eng2.create_run(n, n, board=seed, run_id="f0", wait=False)
+            deadline = time.monotonic() + 60
+            projected = -1
+            while time.monotonic() < deadline:
+                rows = obs_usage.usage_doc().get("capacity", [])
+                if rows:
+                    projected = int(rows[0].get("admissible", -1))
+                    break
+                time.sleep(0.05)
+            admitted = 0
+            if projected >= 0:
+                for i in range(projected * 2 + 8):
+                    try:
+                        eng2.create_run(
+                            n, n,
+                            board=(rng.random((n, n)) < 0.25).astype(
+                                np.uint8),
+                            run_id=f"f{i + 1}", wait=False)
+                        admitted += 1
+                    except RuntimeError:
+                        break
+        finally:
+            eng2.kill_prog()
+        fc_err = (abs(admitted - projected) / projected * 100.0
+                  if projected > 0 else float("inf"))
+        _emit("usage headroom forecast (projected vs admitted-to-"
+              "rejection)", round(fc_err, 2), "%", None,
+              {"size": n, "run_cost_bytes": cost,
+               "projected_admissible": projected,
+               "admitted_to_rejection": admitted,
+               "tolerance_pct": USAGE_FORECAST_TOL_PCT,
+               "method": "gol_capacity_admissible_runs projection "
+                         "read with 1 resident run, then create_run "
+                         "until admission rejects"})
+        if projected <= 0 or fc_err > USAGE_FORECAST_TOL_PCT:
+            print(f"BENCH LEG FAILED (usage): headroom forecast "
+                  f"landed {admitted} vs projected {projected} "
+                  f"({fc_err:.1f}% > "
+                  f"{USAGE_FORECAST_TOL_PCT:.0f}% tolerance)",
+                  file=sys.stderr)
+            rc |= 1
+    finally:
+        for v, val in saved.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
+    return rc
+
+
 # Fleet leg sizing: run counts spanning single-run through saturated
 # batch, each measured over a free-running wall-clock window. The 512
 # count is the ISSUE's acceptance point (aggregate cups >= 10x a
@@ -3196,6 +3374,13 @@ def main() -> int:
                          f"{JOURNAL_DIGEST_EVERY} turns (emits the "
                          "gated journal_overhead_pct line; combine "
                          "only with --turns)")
+    ap.add_argument("--usage", action="store_true",
+                    help="run the per-run usage metering leg only: "
+                         f"{USAGE_RUNS} resident 512² fleet runs "
+                         "free-running with the meter on (emits the "
+                         "gated usage_overhead_pct / "
+                         "usage_attribution_error_pct lines plus the "
+                         "capacity headroom-forecast check)")
     ap.add_argument("--migrate", action="store_true",
                     help="run the live-migration leg only: 3 --fleet "
                          "--federate member processes behind an "
@@ -3367,6 +3552,16 @@ def _dispatch(args, ap) -> int:
                      "--turns")
         return bench_journal(
             turns=args.turns if args.turns is not None else 0)
+
+    if args.usage:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.chaos or args.fleet or args.load \
+                or args.mesh or args.fuse or args.broadcast \
+                or args.size is not None or args.turns is not None:
+            ap.error("--usage is its own config; it takes no other "
+                     "leg flags")
+        return bench_usage()
 
     if args.fuse:
         if args.pattern != "dense" or args.gen or args.engine \

@@ -211,6 +211,13 @@ class EpochStream:
                 self._latest_key = bf
             self._seq += 1
         obs.BCAST_FRAMES.labels(kind="key" if key else "delta").inc()
+        try:  # publish-side attribution, pre fan-out (PR 19)
+            from gol_tpu.obs import usage as obs_usage
+            # "" = the legacy single-run stream, owned by run "run0".
+            obs_usage.METER.charge_broadcast(
+                self.run_id or "run0", 1, len(raw))
+        except Exception:
+            pass
         self._since_key = 0 if key else self._since_key + 1
         self._basis = (int(turn), (int(fy), int(fx)), out)
         self._last_turn = int(turn)

@@ -303,6 +303,12 @@ class CheckpointWriter:
                 manifest["journal"] = jinfo
             mf.write_manifest(man_path, manifest)  # durability bit LAST
             obs.CKPT_BYTES.inc(payload_bytes)
+            try:  # per-run storage attribution (PR 19), best-effort
+                from gol_tpu.obs import usage as obs_usage
+                obs_usage.METER.charge_ckpt(
+                    self.run_id or "run0", payload_bytes)
+            except Exception:
+                pass
             self.retention.apply(self.directory, locked=True)
         return man_path
 

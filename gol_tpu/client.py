@@ -478,6 +478,20 @@ class RemoteEngine:
                 "path": resp.get("path"),
                 "records": list(resp.get("records", []))}
 
+    def get_usage(self) -> dict:
+        """The owning member's per-run usage doc (PR 19): top-K
+        talkers by device-time share, wire/broadcast/checkpoint/
+        journal bytes, attribution conservation, and the capacity
+        headroom rows. A RemoteEngine bound to a run also gets that
+        run's live record under "run" (the run_id rides the standard
+        header, so the federation router relays to the owner)."""
+        resp, _ = self._call({"method": "GetUsage"},
+                             timeout=self._timeout)
+        doc = dict(resp["usage"])
+        if "run" in resp:
+            doc["run"] = dict(resp["run"])
+        return doc
+
     def abort_run(self) -> bool:
         """Stop the engine's current run IF it is this controller's own
         (token match); returns whether an abort was delivered."""

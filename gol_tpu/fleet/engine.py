@@ -79,6 +79,7 @@ from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import devstats as obs_devstats
 from gol_tpu.obs import slo as obs_slo
 from gol_tpu.obs import timeline as obs_timeline
+from gol_tpu.obs import usage as obs_usage
 from gol_tpu.obs.log import exception as obs_exception
 from gol_tpu.obs.log import log as obs_log
 from gol_tpu.ops.bitpack import WORD_BITS, packed_run_turns
@@ -340,6 +341,7 @@ class FleetEngine(ControlFlagProtocol):
                     if qok:
                         handle.enqueued_s = time.monotonic()
                         self._runs[run_id] = handle
+                        obs_usage.METER.track(run_id)
                         self._waitq.append(handle)
                         self._journal_create(handle, board01, derived)
                         self._wake.notify_all()
@@ -348,6 +350,7 @@ class FleetEngine(ControlFlagProtocol):
                 self.admission.reject(reason or "unknown")
                 raise RuntimeError(f"admission rejected: {reason}")
             self._runs[run_id] = handle
+            obs_usage.METER.track(run_id)
             self._placeq.append(handle)
             self._journal_create(handle, board01, derived)
             self._wake.notify_all()
@@ -466,6 +469,7 @@ class FleetEngine(ControlFlagProtocol):
                 self.admission.reject(reason or "unknown")
                 raise RuntimeError(f"admission rejected: {reason}")
             self._runs[rid] = handle
+            obs_usage.METER.track(rid)
             self._wake.notify_all()
         obs_log("fleet.adopt", run_id=rid, turn=handle.turn,
                 rule=run_rule.rulestring, board=f"{h_}x{w_}")
@@ -573,9 +577,11 @@ class FleetEngine(ControlFlagProtocol):
                 except queue_mod.Empty:
                     break
             h.migrating = None
-            # The run lives on elsewhere: bookend this segment with
-            # migrate_out (not "end") so the stitched lineage reads as
-            # a handoff, not a termination.
+            # The run lives on elsewhere: flush its final usage
+            # accounting, then bookend this segment with migrate_out
+            # (not "end") so the stitched lineage reads as a handoff,
+            # not a termination.
+            self._retire_usage_locked(h)
             self._journal_event(h.run_id, "migrate_out",
                                 turn=int(h.turn))
             self._remove_locked(h, journal_end=False)
@@ -661,6 +667,7 @@ class FleetEngine(ControlFlagProtocol):
                 self.admission.reject(reason or "unknown")
                 raise RuntimeError(f"admission rejected: {reason}")
             self._runs[rid] = handle
+            obs_usage.METER.track(rid)
         obs_log("fleet.import", run_id=rid, turn=handle.turn,
                 rule=run_rule.rulestring, board=f"{h_}x{w_}")
         jstamp = journal_head or {}
@@ -861,6 +868,7 @@ class FleetEngine(ControlFlagProtocol):
                 # Legacy runs predate admission: never rejected, never
                 # charged (admitted_cost stays 0).
                 self._runs[LEGACY_RUN_ID] = handle
+                obs_usage.METER.track(LEGACY_RUN_ID)
                 # Transfer any flags posted before the run existed.
                 try:
                     while True:
@@ -1439,6 +1447,9 @@ class FleetEngine(ControlFlagProtocol):
         # plain local lists on the hot path, folded into the bounded
         # log-bucket estimators only at flush time.
         pend_quantum: Dict[str, List[float]] = {}
+        # Per-dispatch usage attribution tuples (PR 19): plain local
+        # appends on the hot path, apportioned by the meter at flush.
+        pend_usage: List[tuple] = []
         overhead_accum = 0.0
         overhead_iters = 0
         last_cups = 0.0
@@ -1477,6 +1488,7 @@ class FleetEngine(ControlFlagProtocol):
             if self._ckpt_pool is not None:
                 obs.CKPT_POOL_DEPTH.set(self._ckpt_pool.depth())
             self._flush_slo_locked(now, pend_quantum)
+            self._flush_usage_locked(pend_usage)
             last_flush = now
 
         while not self._killed:
@@ -1516,6 +1528,7 @@ class FleetEngine(ControlFlagProtocol):
                 last_end[key] = t_done
                 useful_cells = 0
                 run_ids: List[str] = []
+                active_usage: List[Tuple[str, int]] = []
                 top_turn = 0
                 slot_bits = bucket.hb * bucket.wb
                 poison_on = bool(os.environ.get("GOL_CHAOS"))
@@ -1539,6 +1552,7 @@ class FleetEngine(ControlFlagProtocol):
                     h.alive_turn = h.turn
                     h.advanced_s = t_done
                     useful_cells += h.h * h.w
+                    active_usage.append((h.run_id, h.h * h.w))
                     top_turn = max(top_turn, h.turn)
                     if len(run_ids) < 8:
                         run_ids.append(h.run_id)
@@ -1568,6 +1582,8 @@ class FleetEngine(ControlFlagProtocol):
                 pend_chunks += 1
                 pend_turns += chunk * len(stepped)
                 pend_elapsed.append(elapsed)
+                pend_usage.append(
+                    (bucket.placement, elapsed, chunk, active_usage))
                 overhead_accum += max(0.0, elapsed - wait_s)
                 overhead_iters += 1
                 if elapsed > 0:
@@ -1647,6 +1663,58 @@ class FleetEngine(ControlFlagProtocol):
                  "turn": h.turn, "state": h.state}
                 for ms, h in rows[:5]]
         obs_slo.set_fleet_health(doc)
+
+    def _flush_usage_locked(self, pend_usage: List[tuple]) -> None:
+        """Hand the flush window's dispatch tuples to the usage meter
+        and refresh the capacity headroom model (PR 19, fleet lock
+        held, batched flush cadence only). Headroom per bucket class:
+        min(free admission budget // per-run memory charge, free
+        slots) runs, converted to CUPS via the class's measured mean
+        quantum wall — unmeasured classes project 0 CUPS headroom
+        rather than inventing a rate."""
+        try:
+            obs_usage.METER.ingest_dispatches(pend_usage)
+            pend_usage.clear()
+            adm = self.admission.summary()
+            free = max(0, adm["budget_bytes"] - adm["committed_bytes"])
+            slots_free = max(0, adm["max_runs"] - adm["resident"])
+            rows: List[dict] = []
+            for size in self.bucket_sizes:
+                wpb = (size + WORD_BITS - 1) // WORD_BITS
+                cost = run_cost(size, wpb)
+                projected = min(free // cost, slots_free) if cost else 0
+                est = self._quantum_est.get(f"{size}x{size}")
+                snap = est.snapshot() if est is not None else None
+                mean_s = (snap["sum"] / snap["count"]
+                          if snap and snap["count"] else 0.0)
+                cups_hr = (projected * size * size
+                           * self.turns_per_dispatch / mean_s
+                           if mean_s > 0 else 0.0)
+                rows.append({
+                    "bucket": f"{size}x{size}",
+                    "run_cost_bytes": cost,
+                    "admissible": int(projected),
+                    "quantum_mean_ms": round(mean_s * 1e3, 3),
+                    "cups_headroom": round(cups_hr, 1),
+                    "free_bytes": free,
+                    "slots_free": slots_free,
+                })
+            obs_usage.METER.publish(capacity=rows)
+        except Exception:
+            pass  # accounting trouble never stops serving
+
+    def _retire_usage_locked(self, h: RunHandle) -> None:
+        """Flush a run's final lifetime accounting into its journal
+        before the terminal bookend, so GetJournal can audit a
+        destroyed or migrated-away run's resource totals. Idempotent:
+        the meter retires a run exactly once."""
+        try:
+            rec = obs_usage.METER.retire(h.run_id)
+        except Exception:
+            rec = None
+        if rec:
+            self._journal_event(h.run_id, "usage", turn=int(h.turn),
+                                **rec)
 
     def _device_resident_locked(self) -> List[int]:
         """Resident-run count per placement-device index. Batch buckets
@@ -2040,6 +2108,7 @@ class FleetEngine(ControlFlagProtocol):
             # the per-run writer had); only the directory core is
             # dropped so the pool's map cannot grow unboundedly.
             self._ckpt_pool.forget(h.run_id)
+        self._retire_usage_locked(h)
         if journal_end:
             self._journal_event(h.run_id, "end", turn=int(h.turn))
         journal_mod.forget(h.run_id)
@@ -2060,6 +2129,9 @@ class FleetEngine(ControlFlagProtocol):
         h.frozen = np.ascontiguousarray(board[: h.h, : h.w])
         self._board_turns += rem
         self._cell_updates += rem * h.h * h.w
+        # Host-side remainder turns: no dispatch wall to apportion,
+        # but the advancement itself is attributable work.
+        obs_usage.METER.charge_turns(h.run_id, rem, rem * h.h * h.w)
         self._park_locked(bucket, h)
 
 

@@ -85,6 +85,10 @@ class ViewerGateway:
         self._samples: list = []
         self._sent_bytes = 0
         self._sent_msgs = 0
+        # Delivered per-run (frames, bytes) since the last flush —
+        # touched only by the event-loop thread, handed to the usage
+        # meter at the 0.5 s flush (PR 19).
+        self._usage_pend: dict = {}
         self._last_flush = 0.0
 
     # --------------------------------------------------------- lifecycle
@@ -238,6 +242,9 @@ class ViewerGateway:
                 if sub.mv is not None:
                     n = sub.sock.send(sub.mv[sub.off:])
                     self._sent_bytes += n
+                    pend = self._usage_pend.setdefault(
+                        sub.stream.run_id or "run0", [0, 0])
+                    pend[1] += n
                     sub.off += n
                     if sub.off < len(sub.mv):
                         self._set_write(sub, True)
@@ -245,6 +252,8 @@ class ViewerGateway:
                     # Frame fully handed to the kernel: the fan-out
                     # latency sample for this (frame, subscriber).
                     self._sent_msgs += 1
+                    self._usage_pend.setdefault(
+                        sub.stream.run_id or "run0", [0, 0])[0] += 1
                     cur = sub.cur
                     sub.mv = None
                     sub.cur = None
@@ -327,6 +336,13 @@ class ViewerGateway:
             obs.WIRE_MESSAGES.labels(direction="sent").inc(self._sent_msgs)
             self._sent_bytes = 0
             self._sent_msgs = 0
+        if self._usage_pend:
+            try:  # delivered per-(run, subscriber) attribution (PR 19)
+                from gol_tpu.obs import usage as obs_usage
+                obs_usage.METER.charge_broadcast_sent(self._usage_pend)
+            except Exception:
+                pass
+            self._usage_pend = {}
         obs.BCAST_SUBSCRIBERS.set(len(self._subs))
         obs.GATEWAY_CONNECTIONS.set(self.connections())
 

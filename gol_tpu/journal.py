@@ -63,7 +63,7 @@ GENESIS = "0" * 64
 # Every event kind a journal may carry (closed set — the catalog
 # pre-seeds the metric children from the same tuple).
 KINDS = ("create", "rule", "reseed", "pause", "resume", "fuse", "link",
-         "restore", "digest", "migrate_out", "end", "other")
+         "restore", "digest", "migrate_out", "usage", "end", "other")
 
 # Seed boards larger than this (compressed) are journaled digest-only:
 # the record proves WHAT seeded the run without making the journal a
@@ -267,6 +267,11 @@ class JournalWriter:
         obs.JOURNAL_EVENTS.labels(kind=label).inc()
         obs.JOURNAL_BYTES.inc(len(line) + 1)
         obs.JOURNAL_WALL_US.inc((time.perf_counter() - t0) * 1e6)
+        try:  # best-effort per-run attribution (PR 19, self-timed)
+            from gol_tpu.obs import usage as obs_usage
+            obs_usage.METER.charge_journal(self.run_id, len(line) + 1)
+        except Exception:
+            pass
         if kind == "digest":
             obs.JOURNAL_DIGESTS.inc()
         return rec
@@ -472,8 +477,9 @@ def verify_file(path: str, expected_head: Optional[str] = None,
 
 #: Kinds that may legitimately trail the head a link event references:
 #: the transfer captures the head at quiesce, then the source still
-#: appends its sync-checkpoint digest and the migrate_out/end bookend.
-_TRAILING_KINDS = ("digest", "migrate_out", "end")
+#: appends its sync-checkpoint digest, the final usage accounting
+#: record, and the migrate_out/end bookend.
+_TRAILING_KINDS = ("digest", "migrate_out", "usage", "end")
 
 
 def verify_segments(segments: Sequence[Sequence[dict]]) -> dict:

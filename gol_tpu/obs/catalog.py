@@ -21,7 +21,7 @@ WIRE_METHODS = (
     "CreateRun", "ListRuns", "AttachRun", "DestroyRun", "SetRule",
     "RegisterMember", "AdoptRun", "Subscribe",
     "Rescale", "ReceiveRun", "CommitRun", "PinRun",
-    "GetTelemetry", "GetAudit", "GetJournal",
+    "GetTelemetry", "GetAudit", "GetJournal", "GetUsage",
     "unknown",
 )
 
@@ -471,7 +471,8 @@ for _q in SLO_QUANTILES:
 # drop family labels mirror export.FAMILY_LABELS plus "events" — a
 # closed set, so an over-budget snapshot meters exactly what it shed.
 SNAPSHOT_FAMILIES = ("resident", "queue", "staleness", "quantum",
-                     "slo", "cups", "dev_bytes", "events", "unknown")
+                     "slo", "cups", "dev_bytes", "usage", "events",
+                     "unknown")
 
 FED_SNAPSHOT_BYTES = REGISTRY.gauge(
     "gol_fed_snapshot_bytes",
@@ -600,8 +601,8 @@ for _k in AUDIT_KINDS:
 # hash-chained black box. Kinds mirror journal.KINDS — a closed set so
 # an arbitrary append can't mint unbounded label values.
 JOURNAL_KINDS = ("create", "rule", "reseed", "pause", "resume", "fuse",
-                 "link", "restore", "digest", "migrate_out", "end",
-                 "other")
+                 "link", "restore", "digest", "migrate_out", "usage",
+                 "end", "other")
 JOURNAL_EVENTS = REGISTRY.counter(
     "gol_journal_events_total",
     "gol-journal/1 records appended to per-run hash-chained journals "
@@ -634,6 +635,81 @@ REPLAY_DIVERGENCE = REGISTRY.counter(
     "engine; any increment means the recorded history and the engine "
     "no longer agree and the auditor has bisected to the first "
     "divergent turn.")
+
+
+# ------------------------- per-run usage metering & capacity attribution
+
+# Per-run accumulators live on the reference-swapped /healthz "usage"
+# doc (top-K talkers, K = GOL_USAGE_TOPK), never as metric labels —
+# the PR-8 cardinality posture. The registry only carries bounded
+# scalars and the closed per-bucket capacity families below.
+USAGE_RUNS_TRACKED = REGISTRY.gauge(
+    "gol_usage_runs_tracked",
+    "Runs with exact usage accumulators open on this member (resident "
+    "runs only; bounded by the admission controller's max_runs, not by "
+    "lifetime run count).")
+USAGE_WALL_US = REGISTRY.counter(
+    "gol_usage_wall_us_total",
+    "Host wall microseconds spent inside the usage meter — dispatch "
+    "apportionment, per-run charge updates, and doc rebuilds. The "
+    "bench.py --usage leg gates this as a share of run wall "
+    "(usage_overhead_pct), the same in-process cost-accounting pattern "
+    "as journal_overhead_pct: a direct measure that cannot flap with "
+    "host contention the way differential wall clock does.")
+USAGE_FLUSHES = REGISTRY.counter(
+    "gol_usage_flushes_total",
+    "Usage-doc rebuilds (reference-swapped /healthz 'usage' doc), "
+    "throttled to at most one per GOL_USAGE_FLUSH_S.")
+USAGE_UNTRACKED = REGISTRY.counter(
+    "gol_usage_untracked_total",
+    "Charges whose run id had no open accumulator (late broadcast or "
+    "checkpoint stragglers after destroy, unscoped legacy traffic) — "
+    "folded into a single aggregate so stragglers can never grow the "
+    "per-run map past its bound.")
+
+# Capacity headroom model: measured quantum wall + the admission
+# controller's memory charge, projected per bucket class. Bucket label
+# values are the engine's configured "<h>x<w>" classes — env-dependent
+# like gol_fleet_quantum_ms, so not pre-seeded here.
+CAPACITY_ADMISSIBLE_RUNS = REGISTRY.gauge(
+    "gol_capacity_admissible_runs",
+    "Projected additional runs of this bucket class the member could "
+    "admit right now: min(free admission budget // per-run memory "
+    "charge, free slots).",
+    label_names=("bucket",))
+CAPACITY_CUPS_HEADROOM = REGISTRY.gauge(
+    "gol_capacity_cups_headroom",
+    "Projected additional cell updates per second this bucket class "
+    "could absorb: admissible runs x cells per board x turns per "
+    "dispatch / measured mean quantum wall (0 until a quantum has "
+    "been measured).",
+    label_names=("bucket",))
+CAPACITY_RUN_COST_BYTES = REGISTRY.gauge(
+    "gol_capacity_run_cost_bytes",
+    "Admission memory charge for one run of this bucket class "
+    "(height x words-per-row x 4 bytes x the double-buffer/halo cost "
+    "factor).",
+    label_names=("bucket",))
+CAPACITY_FREE_BYTES = REGISTRY.gauge(
+    "gol_capacity_free_bytes",
+    "Uncommitted admission memory budget on this member "
+    "(budget_bytes - committed_bytes).")
+
+# Router-side fleet rollups of the heartbeat-borne "use" family.
+FED_AGG_USAGE_RUNS_TRACKED = REGISTRY.gauge(
+    "gol_fed_agg_usage_runs_tracked",
+    "Fleet-wide sum of gol_usage_runs_tracked across live members at "
+    "the router's last telemetry sweep.")
+FED_AGG_USAGE_ADMISSIBLE_RUNS = REGISTRY.gauge(
+    "gol_fed_agg_usage_admissible_runs",
+    "Fleet-wide projected admissible runs: sum over live members of "
+    "each member's best bucket-class gol_capacity_admissible_runs as "
+    "last reported on the heartbeat snapshot.")
+FED_AGG_USAGE_CUPS_HEADROOM = REGISTRY.gauge(
+    "gol_fed_agg_usage_cups_headroom",
+    "Fleet-wide aggregate CUPS headroom: sum of per-member capacity "
+    "headroom (all bucket classes) as last reported on the heartbeat "
+    "snapshot.")
 
 
 # ------------------------------------------- live migration & resharding

@@ -42,7 +42,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from gol_tpu.obs import audit as obs_audit
 from gol_tpu.obs import catalog as obs
@@ -63,10 +63,10 @@ EVENTS_PER_BEAT = 32
 # Family keys in PRIORITY order (first = most important = dropped
 # last when the encoding exceeds the byte budget). Short keys keep the
 # wire encoding compact; the long names are the metric label values.
-FAMILY_PRIORITY = ("res", "q", "st", "qt", "slo", "cups", "dev")
+FAMILY_PRIORITY = ("res", "q", "st", "qt", "slo", "cups", "dev", "use")
 FAMILY_LABELS = {"res": "resident", "q": "queue", "st": "staleness",
                  "qt": "quantum", "slo": "slo", "cups": "cups",
-                 "dev": "dev_bytes"}
+                 "dev": "dev_bytes", "use": "usage"}
 
 
 def snapshot_budget() -> float:
@@ -104,6 +104,17 @@ def collect_families() -> dict:
     peak = sum(c.value for c in obs.DEV_PEAK_BYTES.children().values())
     if live or peak:
         out["dev"] = {"live": int(live), "peak": int(peak)}
+    # Usage & capacity summary (PR 19): tracked-run count, projected
+    # admissible runs (best bucket class), aggregate CUPS headroom and
+    # a tiny top-talker list. Lowest snapshot priority — the first
+    # family dropped when the beat exceeds GOL_FED_SNAPSHOT_MAX.
+    try:
+        from gol_tpu.obs import usage as obs_usage
+        use = obs_usage.METER.export_summary()
+    except Exception:
+        use = None
+    if use:
+        out["use"] = use
     return out
 
 
@@ -274,7 +285,29 @@ class FleetTelemetry:
         mean_res = (sum(residents) / len(residents)) if residents else 0
         imbalance = (max(residents) / mean_res
                      if residents and mean_res > 0 else 1.0)
+        # Usage & capacity rollups (PR 19): sum the heartbeat-borne
+        # "use" summaries and merge the per-member top-talker lists
+        # into one fleet-wide bounded table.
+        use_tracked = sum(int((f.get("use") or {}).get("tracked", 0))
+                          for f in states.values())
+        use_adm = sum(int((f.get("use") or {}).get("adm", 0))
+                      for f in states.values())
+        use_hr = sum(float((f.get("use") or {}).get("hr", 0.0))
+                     for f in states.values())
+        usage_top: List[list] = []
+        for mid, fam in states.items():
+            for row in (fam.get("use") or {}).get("top", []):
+                try:
+                    usage_top.append(
+                        [str(row[0]), float(row[1]), mid])
+                except (TypeError, IndexError, ValueError):
+                    continue
+        usage_top.sort(key=lambda r: r[1], reverse=True)
+        usage_top = usage_top[:8]
 
+        obs.FED_AGG_USAGE_RUNS_TRACKED.set(use_tracked)
+        obs.FED_AGG_USAGE_ADMISSIBLE_RUNS.set(use_adm)
+        obs.FED_AGG_USAGE_CUPS_HEADROOM.set(round(use_hr, 1))
         obs.FED_AGG_RUNS_RESIDENT.set(resident)
         obs.FED_AGG_QUEUE_DEPTH.set(queue_sum)
         obs.FED_AGG_CUPS.set(cups)
@@ -349,7 +382,15 @@ class FleetTelemetry:
                 "members_dead": members_doc.get("dead", 0),
                 "slo_breaches": slo,
                 "dev_live_bytes": dev_live,
+                "usage": {
+                    "runs_tracked": use_tracked,
+                    "admissible_runs": use_adm,
+                    "cups_headroom": round(use_hr, 1),
+                },
             },
+            "usage_top": [
+                {"run_id": rid, "device_s": dev_s, "member": mid}
+                for rid, dev_s, mid in usage_top],
             "members": member_rows,
             "alerts": self.alerts.doc(),
             "tsdb": self.tsdb.doc(),

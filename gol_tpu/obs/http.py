@@ -81,6 +81,17 @@ def healthz_doc() -> dict:
         telemetry = None
     if telemetry:
         doc["telemetry"] = telemetry
+    # Per-run usage & capacity attribution (PR 19): the meter's
+    # reference-swapped doc — top-K talkers, attribution conservation,
+    # capacity headroom rows. Absent while nothing has been metered.
+    try:
+        from gol_tpu.obs import usage as obs_usage
+        usage = obs_usage.usage_doc()
+    except Exception:  # noqa: BLE001 — /healthz must never 500
+        usage = None
+    if usage and (usage.get("runs_tracked") or usage.get("retired_runs")
+                  or usage.get("capacity")):
+        doc["usage"] = usage
     return doc
 
 

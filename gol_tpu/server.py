@@ -104,6 +104,10 @@ class EngineServer:
         # socket to the gateway: _serve_conn's finally must then NOT
         # close the fd the event loop now owns.
         self._adopted_conn = threading.local()
+        # Per-thread ConnectionEncoder of the request being served:
+        # _reply adds its send_msg byte counts there so the dispatch
+        # tail can charge the run named in the header (PR 19).
+        self._usage_ctx = threading.local()
         # Live migration forwarding map (PR 15): run_id -> the member
         # address it migrated to. A straggler whose request was relayed
         # here before the router's pin flipped gets a RETRYABLE
@@ -330,7 +334,10 @@ class EngineServer:
         if key is not None:
             self._dedupe_ctx.key = None
             self._record_reply(key, dict(header))
-        send_msg(conn, header, world, frame=frame)
+        n = send_msg(conn, header, world, frame=frame)
+        enc = getattr(self._usage_ctx, "enc", None)
+        if enc is not None:
+            enc.bytes_out += n
 
     def _record_reply(self, key: str, reply: dict) -> None:
         with self._dedupe_lock:
@@ -510,6 +517,7 @@ class EngineServer:
         # without re-reading the environment or the peer header.
         enc = wire.ConnectionEncoder(header)
         caps = enc.caps
+        self._usage_ctx.enc = enc
         if self._dedupe_check(conn, method, label, header):
             return
         try:
@@ -573,6 +581,19 @@ class EngineServer:
                             if header.get("since_seq") is not None
                             else -1),
                         int(header.get("limit", 100) or 100))})
+            elif method == "GetUsage":
+                # Per-run usage meter + capacity headroom (PR 19).
+                # Like GetJournal, not RUN_SCOPED: without a run_id
+                # the member's whole usage doc (top-K talkers +
+                # capacity rows) answers; with one, the named run's
+                # live record rides along — and an unknown id takes
+                # the standard moved-redirect path below.
+                from gol_tpu.obs import usage as obs_usage
+                rid = str(header.get("run_id") or "")
+                resp = {"ok": True, "usage": obs_usage.usage_doc()}
+                if rid:
+                    resp["run"] = obs_usage.METER.run_doc(rid)
+                self._reply(conn, resp)
             elif method == "Alivecount":
                 alive, turn = eng.alive_count()
                 self._reply(conn,
@@ -856,6 +877,18 @@ class EngineServer:
             else:
                 self._reply(conn, {"ok": False,
                                    "error": f"{type(e).__name__}: {e}"})
+        finally:
+            self._usage_ctx.enc = None
+            rid = header.get("run_id")
+            if rid:
+                # Only run-scoped traffic is tenant cost; unscoped
+                # RPCs (Stats, GetMetrics, ...) are fleet overhead.
+                try:
+                    from gol_tpu.obs import usage as obs_usage
+                    obs_usage.METER.charge_wire(
+                        str(rid), enc.bytes_in, enc.bytes_out)
+                except Exception:
+                    pass
 
     def _restore_run(self, req: str, reshard: bool = False) -> int:
         """RestoreRun target resolution: the request names a checkpoint

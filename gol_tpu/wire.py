@@ -274,14 +274,31 @@ class ConnectionEncoder:
     resolved once at connection setup instead of per reply. The server
     builds one per accepted connection; anything that later streams
     frames on that socket (snapshot replies, live-view pushes) reuses
-    `caps` without touching the environment or the peer header again."""
+    `caps` without touching the environment or the peer header again.
 
-    __slots__ = ("caps", "advert")
+    PR 19 rides the same per-request object for wire-byte attribution:
+    `bytes_in` is the request's on-wire size recomputed from the header
+    (length prefix + canonical header dump + the payload size the
+    framing rules imply — deterministic, no tap on the recv path), and
+    `bytes_out` accumulates send_msg return values so the server can
+    charge the run named in the header once the reply is down."""
+
+    __slots__ = ("caps", "advert", "bytes_in", "bytes_out")
 
     def __init__(self, header: Optional[dict] = None) -> None:
         self.caps = negotiate(header) if header is not None \
             else frozenset()
         self.advert = advertised_caps()
+        self.bytes_out = 0
+        if header is None:
+            self.bytes_in = 0
+        else:
+            try:
+                self.bytes_in = 4 + len(json.dumps(
+                    header, separators=(",", ":"))) \
+                    + payload_nbytes(header)
+            except (WireProtocolError, TypeError, ValueError):
+                self.bytes_in = 0
 
     def stamp(self, header: dict) -> dict:
         """Add this connection's caps advert to a reply header."""

@@ -12,7 +12,10 @@ Polls a federation router's GetTelemetry / GetAudit wire methods (PR
     it has not seen;
   * with `--journal-run RUN_ID`, that run's hash-chained gol-journal/1
     tail (GetJournal, proxied by the router to the run's owner) — the
-    black box pane: chain head, last seq, newest events.
+    black box pane: chain head, last seq, newest events;
+  * with `--usage`, the top-talkers pane (PR 19): per-run device-time
+    share, wire bytes in/out, broadcast bytes, plus the fleet usage
+    rollup and capacity headroom rows from GetUsage/GetTelemetry.
 
     python tools/fleet_top.py --router HOST:PORT            # live
     python tools/fleet_top.py --router HOST:PORT --once     # one frame
@@ -45,10 +48,11 @@ def _si(v: float) -> str:
 
 
 def render(doc: dict, records: list, now: float = None,
-           journal: dict = None) -> str:
+           journal: dict = None, usage: dict = None) -> str:
     """One dashboard frame from a GetTelemetry doc, an audit tail
-    (oldest first), and optionally one run's GetJournal tail. Pure
-    string building — no I/O, no client."""
+    (oldest first), optionally one run's GetJournal tail, and
+    optionally a member GetUsage doc. Pure string building — no I/O,
+    no client."""
     if now is None:
         now = time.time()
     fleet = doc.get("fleet", {})
@@ -132,7 +136,48 @@ def render(doc: dict, records: list, now: float = None,
             lines.append(f"  (journal unavailable: {journal['error']})")
         elif not journal.get("records"):
             lines.append("  (no journal records)")
+
+    if usage is not None:
+        lines.append("")
+        att = usage.get("attribution", {})
+        fleet_use = fleet.get("usage", {})
+        lines.append(
+            "usage  tracked={trk}  attributed={att_s:.2f}s "
+            "(err={err:.2f}%)  headroom={adm} runs / "
+            "{hr} cups".format(
+                trk=usage.get("runs_tracked", 0),
+                att_s=float(att.get("attributed_s", 0.0)),
+                err=float(att.get("error_pct", 0.0)),
+                adm=fleet_use.get("admissible_runs",
+                                  _best_admissible(usage)),
+                hr=_si(float(fleet_use.get(
+                    "cups_headroom", _sum_headroom(usage))))))
+        top = usage.get("top", [])
+        lines.append(f"{'RUN':<22} {'DEV_SHARE':>9} {'TURNS':>8} "
+                     f"{'WIRE_IN':>8} {'WIRE_OUT':>9} {'BCAST':>8}")
+        for row in top:
+            lines.append(
+                f"{str(row.get('run_id', '?'))[:22]:<22} "
+                f"{row.get('share_pct', 0.0):>8.1f}% "
+                f"{_si(float(row.get('turns', 0))):>8} "
+                f"{_si(float(row.get('wire_in', 0))):>8}B "
+                f"{_si(float(row.get('wire_out', 0))):>8}B "
+                f"{_si(float(row.get('bc_bytes', 0) + row.get('sent_bytes', 0))):>7}B")
+        if not top:
+            lines.append("  (no talkers metered)")
+        if usage.get("error"):
+            lines.append(f"  (usage unavailable: {usage['error']})")
     return "\n".join(lines)
+
+
+def _best_admissible(usage: dict) -> int:
+    return max((int(r.get("admissible", 0))
+                for r in usage.get("capacity", [])), default=0)
+
+
+def _sum_headroom(usage: dict) -> float:
+    return sum(float(r.get("cups_headroom", 0.0))
+               for r in usage.get("capacity", []))
 
 
 def fetch_frame(client: RemoteEngine, since_seq: int) -> tuple:
@@ -157,6 +202,16 @@ def fetch_journal(router: str, run_id: str,
                 "error": f"{type(e).__name__}: {e}"}
 
 
+def fetch_usage(client: RemoteEngine) -> dict:
+    """One GetUsage poll. Errors render in-pane instead of killing
+    the dashboard (a pre-PR-19 peer answers 'unknown method')."""
+    try:
+        return client.get_usage()
+    except Exception as e:
+        return {"runs_tracked": 0, "top": [],
+                "error": f"{type(e).__name__}: {e}"}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="terminal dashboard over GetTelemetry/GetAudit")
@@ -169,6 +224,10 @@ def main(argv=None) -> int:
     ap.add_argument("--journal-run", default="", metavar="RUN_ID",
                     help="also render RUN_ID's gol-journal/1 tail "
                          "(GetJournal via the router)")
+    ap.add_argument("--usage", action="store_true",
+                    help="also render the top-talkers pane "
+                         "(GetUsage: device-time share, wire and "
+                         "broadcast bytes per run)")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
@@ -184,7 +243,8 @@ def main(argv=None) -> int:
             jrn = (fetch_journal(args.router, args.journal_run,
                                  timeout=args.timeout)
                    if args.journal_run else None)
-            frame = render(doc, tail, journal=jrn)
+            use = fetch_usage(client) if args.usage else None
+            frame = render(doc, tail, journal=jrn, usage=use)
             if args.once:
                 print(frame)
                 return 0

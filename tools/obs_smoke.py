@@ -174,7 +174,21 @@ def main() -> int:
                        'gol_alerts_active{rule="queue-depth"}',
                        'gol_alerts_fired_total{rule="member-death"}',
                        'gol_audit_records_total{kind="member_death"}',
-                       'gol_audit_records_total{kind="quarantine"}'):
+                       'gol_audit_records_total{kind="quarantine"}',
+                       # PR 19 usage metering & capacity attribution
+                       # (aggregate families only — per-run detail
+                       # lives on the /healthz usage doc, PR-8
+                       # cardinality posture)
+                       "# TYPE gol_usage_runs_tracked gauge",
+                       "# TYPE gol_usage_wall_us_total counter",
+                       "# TYPE gol_usage_flushes_total counter",
+                       "# TYPE gol_usage_untracked_total counter",
+                       "# TYPE gol_capacity_free_bytes gauge",
+                       "# TYPE gol_capacity_admissible_runs gauge",
+                       "# TYPE gol_capacity_cups_headroom gauge",
+                       "# TYPE gol_fed_agg_usage_runs_tracked gauge",
+                       "# TYPE gol_fed_agg_usage_admissible_runs gauge",
+                       "# TYPE gol_fed_agg_usage_cups_headroom gauge"):
             if needle not in body:
                 problems.append(f"/metrics missing {needle!r}")
         if 'gol_profile_captures_total{status="ok"} 1' not in body:
@@ -196,7 +210,9 @@ def main() -> int:
                     "gol_fed_agg_runs_resident",
                     "gol_fed_agg_imbalance_ratio",
                     "gol_tsdb_series", "gol_alerts_active",
-                    "gol_audit_records_total"):
+                    "gol_audit_records_total",
+                    "gol_usage_runs_tracked",
+                    "gol_fed_agg_usage_runs_tracked"):
             if fam not in mjson:
                 problems.append(f"/metrics.json missing {fam!r}")
         alerts_rules = {v["labels"].get("rule")

@@ -69,7 +69,8 @@ DEFAULT_GATE_PATTERN = (
     r"|halo (?:bytes|exchanges)/turn"
     r"|encode_calls_per_published_frame|viewer_fanout_p\d+_ms"
     r"|telemetry_overhead_pct|heartbeat_payload_p\d+_bytes"
-    r"|alert_detection_p\d+_ms|journal_overhead_pct")
+    r"|alert_detection_p\d+_ms|journal_overhead_pct"
+    r"|usage_overhead_pct|usage_attribution_error_pct")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
@@ -170,6 +171,13 @@ def _higher_is_better(metric: str, unit: Optional[str]) -> bool:
     if "availability" in low0:
         return True
     if "retries" in low0:
+        return False
+    # Attribution-error gates (the --usage leg): an error percentage
+    # is a pure COST — its unit "%" hits no heuristic below and the
+    # name carries no overhead/latency token, so without this rule it
+    # would default to higher-is-better and the gate would reward a
+    # meter that stops conserving.
+    if "_error_pct" in low0 or "error_pct" in low0:
         return False
     # Broadcast-tier zero-work witness: encodes per published frame is
     # a flat COST gate (exactly 1.0 when the fan-out tier shares one

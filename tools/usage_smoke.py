@@ -1,0 +1,166 @@
+"""usage-smoke: prove the per-run usage metering plane end to end.
+
+One in-process acceptance scenario (PR 19) against a real fleet
+server socket:
+
+  * a --fleet EngineServer admits three runs and drives them; the
+    usage meter must attribute device time to every one of them with
+    the conservation invariant holding (sum of per-run shares within
+    1% of the measured dispatch wall);
+  * `GetUsage` over the wire returns the bounded top-talkers doc, and
+    a run-scoped client additionally gets its own live record (wire
+    bytes charged by the server dispatch tail must be nonzero — this
+    very RPC pays for itself);
+  * the /healthz body carries the same doc under "usage" (reference-
+    read, PR-8 posture: no per-run metric labels anywhere);
+  * `fleet_top.py --usage` renders the pane headlessly from the
+    fetched doc (pure render call, same code path as --once);
+  * DestroyRun retires the run and writes its final "usage" record
+    into the hash-chained gol-journal/1 black box.
+
+Exit 0 = pass.
+
+    make usage-smoke
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+RUNS = 3
+SIZE = 128
+
+
+def fail(msg: str) -> int:
+    print(f"usage-smoke: FAIL — {msg}", flush=True)
+    return 1
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("GOL_CHAOS", None)
+    tmpdir = tempfile.mkdtemp(prefix="gol_usage_smoke_")
+    # Journal on (the destroy-time usage record lands there); flush
+    # throttle off so every usage_doc() read is rebuilt fresh.
+    os.environ["GOL_JOURNAL"] = os.path.join(tmpdir, "journal")
+    os.environ["GOL_USAGE_FLUSH_S"] = "0"
+
+    from gol_tpu import journal
+    from gol_tpu.client import RemoteEngine
+    from gol_tpu.fleet import FleetEngine
+    from gol_tpu.obs import usage as obs_usage
+    from gol_tpu.obs.http import healthz_doc
+    from gol_tpu.server import EngineServer
+    from tools import fleet_top
+
+    obs_usage.METER.reset()
+    eng = FleetEngine(bucket_sizes=(SIZE,), slot_base=max(RUNS, 8))
+    srv = EngineServer(port=0, host="127.0.0.1", engine=eng)
+    srv.start_background()
+    addr = f"127.0.0.1:{srv.port}"
+    cli = RemoteEngine(addr, timeout=30.0)
+    rids = [f"u{i}" for i in range(RUNS)]
+    try:
+        for rid in rids:
+            cli.create_run(SIZE, SIZE, run_id=rid, target_turn=10_000)
+
+        # Drive until every run has progressed and the meter has
+        # attributed device time to each of them.
+        deadline = time.monotonic() + 120.0
+        doc = {}
+        while time.monotonic() < deadline:
+            doc = obs_usage.usage_doc()
+            top_ids = {r.get("run_id") for r in doc.get("top", [])}
+            if (set(rids) <= top_ids
+                    and all(r.get("device_s", 0.0) > 0
+                            for r in doc["top"])):
+                break
+            time.sleep(0.2)
+        else:
+            return fail(f"meter never attributed all {RUNS} runs "
+                        f"(doc: {doc})")
+        att = doc.get("attribution", {})
+        if not att.get("wall_s", 0.0) > 0:
+            return fail(f"no dispatch wall measured: {att}")
+        if abs(float(att.get("error_pct", 100.0))) > 1.0:
+            return fail(f"conservation violated: {att}")
+        print(f"usage-smoke: {RUNS} runs attributed, wall "
+              f"{att['wall_s']:.3f}s err {att['error_pct']:.4f}%",
+              flush=True)
+
+        # GetUsage over the wire — fleet doc plus the run-scoped view;
+        # the RPC itself must have been charged to the run it names.
+        wire_doc = cli.get_usage()
+        if wire_doc.get("runs_tracked", 0) < RUNS:
+            return fail(f"GetUsage runs_tracked: {wire_doc}")
+        rcli = RemoteEngine(addr, timeout=30.0, run_id=rids[0])
+        mine = rcli.get_usage().get("run", {})
+        if mine.get("run_id") != rids[0]:
+            return fail(f"run-scoped GetUsage record: {mine}")
+        for _ in range(2):  # second poll sees the first one's bytes
+            mine = rcli.get_usage().get("run", {})
+        if not (mine.get("wire_in", 0) > 0 and mine.get("wire_out", 0) > 0):
+            return fail(f"GetUsage RPC not charged to its run: {mine}")
+        if not wire_doc.get("capacity"):
+            return fail("no capacity headroom rows on the wire doc")
+        print("usage-smoke: GetUsage serves top-K + capacity rows; "
+              f"{rids[0]} charged wire_in={mine['wire_in']}B "
+              f"wire_out={mine['wire_out']}B", flush=True)
+
+        # /healthz carries the doc (reference read, no metric labels).
+        hz = healthz_doc()
+        if hz.get("usage", {}).get("runs_tracked", 0) < RUNS:
+            return fail(f"/healthz usage doc: {hz.get('usage')}")
+
+        # Headless fleet_top --usage pane over the fetched doc.
+        frame = fleet_top.render({}, [], usage=wire_doc)
+        if "usage  tracked=" not in frame or rids[0] not in frame:
+            return fail(f"fleet_top usage pane:\n{frame}")
+        print("usage-smoke: /healthz doc + fleet_top pane render",
+              flush=True)
+
+        # DestroyRun writes the final usage record into the journal.
+        cli.destroy_run(rids[0])
+        jpath = journal.journal_path(rids[0])
+        records, torn = journal.load_records(jpath)
+        if torn is not None:
+            return fail(f"journal torn line at {torn}")
+        urec = next((r for r in records if r.get("kind") == "usage"),
+                    None)
+        if urec is None:
+            return fail("no final usage record in the journal "
+                        f"(kinds: {sorted({r.get('kind') for r in records})})")
+        if not (urec.get("device_s", 0.0) > 0
+                and urec.get("turns", 0) > 0):
+            return fail(f"empty final usage record: {urec}")
+        try:
+            obs_usage.METER.run_doc(rids[0])
+            return fail("destroyed run still tracked by the meter")
+        except KeyError:
+            pass
+        print(f"usage-smoke: destroy wrote final usage record "
+              f"(device_s={urec['device_s']:.4f}, "
+              f"turns={urec['turns']})", flush=True)
+        print("usage-smoke: PASS", flush=True)
+        return 0
+    finally:
+        try:
+            eng.kill_prog()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    rc = main()
+    # os._exit dodges the known XLA daemon-thread teardown abort;
+    # every gate already flushed its verdict.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)
