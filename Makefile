@@ -1,6 +1,6 @@
 # Convenience targets; everything also works as plain commands.
 
-.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke usage-smoke smoke perf-gate native fixtures clean
+.PHONY: test bench obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke usage-smoke conv-smoke smoke perf-gate native fixtures clean
 
 test:
 	python -m pytest tests/ -q
@@ -202,8 +202,19 @@ usage-smoke:
 	python tools/perf_compare.py BASELINE.json out/usage_smoke.jsonl
 	JAX_PLATFORMS=cpu python tools/usage_smoke.py
 
+# Conv/FFT kernel-tier check, CPU-only: small-board parity only (no
+# timing — `bench.py --conv` owns the gated 4096² crossover): conv and
+# fft tiers bit-identical to the numpy oracle for LtL rules, the Lenia
+# float32 step within 1e-4 of the float64 oracle on both tiers, real
+# Engine runs for both families (incl. a lossless f32 frame to a
+# CAP_F32 peer), the select_tier policy surface, and the
+# gol_conv_dispatches_total / gol_kernel_tier families
+# (tools/conv_smoke.py).
+conv-smoke:
+	JAX_PLATFORMS=cpu python tools/conv_smoke.py
+
 # Every end-to-end smoke in one chain (CPU-only, no artifacts needed).
-smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke usage-smoke
+smoke: obs-smoke ckpt-smoke wire-smoke perf-smoke fleet-smoke load-smoke broadcast-smoke mesh-smoke fleet-mesh-smoke chaos-smoke federation-smoke migrate-smoke fuse-smoke fleet-obs-smoke journal-smoke usage-smoke conv-smoke
 
 # Perf-regression gate: compare the latest BENCH_r*.json artifact (or
 # PERF_CANDIDATE=<file>) against the committed BASELINE.json published

@@ -832,6 +832,242 @@ def bench_fuse(ks=FUSE_KS, sizes=None, turns_override: int = 0,
     return rc
 
 
+# Kernel-tier crossover sweep (`--conv`): every radius-capable tier
+# timed on the SAME evolution at a fixed dense board, parity-gated
+# bit-identical against the independent numpy summed-area oracle.
+# Turns taper with radius so oracle+timed cost stays bounded; within
+# one radius every tier runs the same turn count, so the cups entries
+# are directly comparable and the crossover table is honest.
+CONV_N = 4096
+CONV_RADII = (1, 2, 4, 8, 16, 32)
+CONV_TURNS = {1: 8, 2: 8, 4: 8, 8: 8, 16: 4, 32: 4}
+CONV_FUSE_K = 8        # declared fusion depth for the r=1 fused leg
+CONV_WITHIN_PCT = 10.0  # policy pick must be within this of the best
+# Lenia legs: the float64 numpy oracle's digest after CONV_LENIA_TURNS
+# turns from the pinned seed is asserted against the constants below;
+# the float32 engine output is tied to the oracle by max-abs tolerance
+# (digest-equality between float32 engine and float64 oracle would be
+# flaky by construction — ~1e-6 round-off straddles the digest's
+# 3-decimal rounding boundary on ~1e-4 of cells).
+CONV_LENIA_TURNS = 8
+CONV_LENIA_SEED = 42
+CONV_LENIA_TOL = 1e-4
+CONV_LENIA_LEGS = (
+    # (board n, rulestring, tier, pinned oracle digest)
+    (1024, "lenia:r=13,mu=0.15,sigma=0.015,dt=0.1", "fft",
+     "21229d660f4917e215c5520a7d6f5730bbbd1a34690d669ac53e13067724d0ad"),
+    (512, "lenia:r=4,mu=0.15,sigma=0.015,dt=0.1", "conv",
+     "fdccc85216d957fd11e7046c014ef0c44b56fa8a429e47869c2b18ea8bec650c"),
+)
+
+
+def _conv_rule(r: int):
+    """The swept LtL rule at radius r: Conway itself at r=1 (R1,C0,M0,
+    S2..3,B3,NM is B3/S23, so the packed bitplane/fused tiers run the
+    IDENTICAL evolution and all four tiers are comparable on one
+    board), Bosco's Rule scaled to the neighborhood area for r > 1 —
+    the same survive/birth fractions as R5 Bosco (reproduced exactly
+    at r=5), which stay chaotic rather than freezing or flashing."""
+    from gol_tpu.models.largerthanlife import (
+        CONWAY_LTL,
+        LargerThanLifeRule,
+    )
+
+    if r == 1:
+        return CONWAY_LTL
+    area = (2 * r + 1) ** 2
+    s_lo, s_hi = round(0.273 * area), round(0.471 * area)
+    b_lo, b_hi = round(0.281 * area), round(0.372 * area)
+    return LargerThanLifeRule(
+        f"R{r},C0,M1,S{s_lo}..{s_hi},B{b_lo}..{b_hi},NM")
+
+
+def bench_conv(n: int = CONV_N, radii=CONV_RADII,
+               turns_override: int = 0) -> int:
+    """Kernel-tier legs (`--conv`): the four-way crossover sweep.
+
+    Binary sweep — r ∈ CONV_RADII at n²: the conv and fft tiers run
+    the swept LtL rule; at r=1 the bitplane and fused (k=CONV_FUSE_K)
+    packed tiers join on the equivalent B3/S23 rule. EVERY leg is
+    parity-gated bit-identical against `largerthanlife.run_turns_np`
+    (summed-area table — no convolution, no FFT anywhere near it).
+
+    Auto-select gate — at each radius, `select_tier` (under the
+    bench's declared GOL_FUSE_K, so the policy sees the config the
+    fused leg measures) must pick a tier within CONV_WITHIN_PCT of the
+    best measured cups (the tolerance absorbs run-to-run noise near
+    the crossover). The gated `conv_autoselect_win_pct` is 100 when
+    the policy wins at every swept radius; the full per-radius
+    {tier: cups} crossover table rides in its detail.
+
+    Lenia legs — float32 continuous boards from the pinned seed: the
+    float64 numpy oracle must reproduce its pinned digest, the engine
+    must match the oracle within CONV_LENIA_TOL max-abs."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.models import largerthanlife as ltl
+    from gol_tpu.models import lenia as lenia_mod
+    from gol_tpu.models.lifelike import CONWAY
+    from gol_tpu.ops import conv as conv_ops
+    from gol_tpu.ops.bitpack import pack
+    from gol_tpu.ops.bitpack import packed_run_turns as packed_run
+    from gol_tpu.ops.fused import fused_packed_run_turns
+    from gol_tpu.utils.sync import wait
+
+    platform = jax.devices()[0].platform
+    rc = 0
+    radii = tuple(sorted(set(int(r) for r in radii)))
+    rng = np.random.default_rng(11)
+    board01 = (rng.random((n, n)) < 0.35).astype(np.uint8)
+    words = jnp.asarray(np.asarray(pack(board01)))
+    cells01 = jnp.asarray(board01)
+
+    def _timed(run):
+        wait(run())  # compile + warm at the timed length
+        t0 = time.perf_counter()
+        out = run()
+        wait(out)
+        return out, time.perf_counter() - t0
+
+    # Declare the fusion depth so the auto policy sees the same config
+    # the fused leg measures (select_tier only offers the fused tier
+    # when a depth is configured), restoring the ambient value after.
+    prev_fuse = os.environ.get("GOL_FUSE_K")
+    os.environ["GOL_FUSE_K"] = str(CONV_FUSE_K)
+    try:
+        table = {}
+        for r in radii:
+            rule = _conv_rule(r)
+            turns = turns_override or CONV_TURNS.get(r, 4)
+            oracle = np.asarray(
+                ltl.run_turns_np(board01, turns, rule), dtype=np.uint8)
+            runs = {}
+            if r == 1:
+                runs["bitplane"] = lambda t=turns: packed_run(
+                    words, t, CONWAY)
+                runs["fused"] = lambda t=turns: fused_packed_run_turns(
+                    words, t, CONWAY, fuse=CONV_FUSE_K,
+                    platform=platform)
+            runs["conv"] = lambda t=turns: conv_ops.run_turns(
+                cells01, t, rule, tier="conv")
+            runs["fft"] = lambda t=turns: conv_ops.run_turns(
+                cells01, t, rule, tier="fft")
+            legs = {}
+            for tier, run in runs.items():
+                out, elapsed = _timed(run)
+                got = (_unpack_words(out)[:, :n]
+                       if tier in ("bitplane", "fused")
+                       else np.asarray(out, dtype=np.uint8))
+                parity = bool(np.array_equal(got, oracle))
+                if not parity:
+                    print(f"PARITY FAIL (conv {tier} r={r} {n}x{n}): "
+                          f"output differs from the numpy "
+                          f"summed-area oracle", file=sys.stderr)
+                    rc = 1
+                cups = turns * n * n / elapsed
+                legs[tier] = cups
+                _emit(
+                    f"cell-updates/sec (conv, {tier}, r={r}, "
+                    f"{n}x{n})",
+                    round(cups, 1), "cell-updates/s", None,
+                    {"radius": r, "turns": turns, "tier": tier,
+                     "rulestring": rule.rulestring,
+                     "elapsed_s": round(elapsed, 4),
+                     "platform": platform, "alive_parity": parity,
+                     "parity_check": f"{turns}-turn full-board "
+                                     f"bit-identity vs numpy "
+                                     f"summed-area oracle"})
+            policy = conv_ops.select_tier(n, n, r, "uint8")
+            best = max(legs, key=legs.get)
+            ok = legs[policy] >= (
+                1.0 - CONV_WITHIN_PCT / 100.0) * legs[best]
+            if not ok:
+                print(f"POLICY FAIL (conv r={r}): auto-selected "
+                      f"{policy} at {legs[policy]:.3g} cups, but "
+                      f"{best} measured {legs[best]:.3g}",
+                      file=sys.stderr)
+                rc = 1
+            table[r] = {
+                "tiers": {t: round(c, 1) for t, c in legs.items()},
+                "turns": turns, "policy": policy,
+                "measured_best": best, "policy_ok": ok}
+
+        wins = sum(1 for v in table.values() if v["policy_ok"])
+        win_pct = 100.0 * wins / max(len(table), 1)
+        xover = next(
+            (r for r in radii
+             if table[r]["tiers"]["fft"] > table[r]["tiers"]["conv"]),
+            None)
+        detail = {
+            "board": [n, n], "radii": list(radii),
+            "within_pct": CONV_WITHIN_PCT, "fuse_k": CONV_FUSE_K,
+            "crossover_table": table,
+            "measured_fft_crossover_radius": xover,
+            "configured_crossover_radius":
+                conv_ops._crossover_radius(n * n),
+            "platform": platform}
+        _emit("conv_autoselect_win_pct", round(win_pct, 1), "%",
+              None, detail)
+        if xover is not None:
+            _emit(f"conv fft-crossover radius ({n}x{n})", xover,
+                  "radius", None, detail)
+
+        # ---- Lenia legs: pinned-seed digest + tolerance gates
+        for ln, rulestring, tier, pinned in CONV_LENIA_LEGS:
+            lrule = lenia_mod.LeniaRule(rulestring)
+            state0 = lenia_mod.seed_board(ln, ln, CONV_LENIA_SEED,
+                                          lrule)
+            ref = state0
+            for _ in range(CONV_LENIA_TURNS):
+                ref = lenia_mod.step_np(ref, lrule)
+            digest = lenia_mod.board_digest(ref)
+            digest_ok = digest == pinned
+            if not digest_ok:
+                print(f"PARITY FAIL (lenia {tier} {ln}x{ln}): oracle "
+                      f"digest {digest[:16]}… != pinned "
+                      f"{pinned[:16]}…", file=sys.stderr)
+                rc = 1
+            out, elapsed = _timed(
+                lambda s=jnp.asarray(state0), lr=lrule, t=tier:
+                conv_ops.run_turns(s, CONV_LENIA_TURNS, lr, tier=t))
+            err = float(np.max(np.abs(
+                np.asarray(out, dtype=np.float64)
+                - np.asarray(ref, dtype=np.float64))))
+            if err >= CONV_LENIA_TOL:
+                print(f"PARITY FAIL (lenia {tier} {ln}x{ln}): "
+                      f"max|engine - oracle| = {err:.3g} >= "
+                      f"{CONV_LENIA_TOL}", file=sys.stderr)
+                rc = 1
+            cups = CONV_LENIA_TURNS * ln * ln / elapsed
+            _emit(
+                f"cell-updates/sec (conv, lenia-{tier}, "
+                f"r={lrule.radius}, {ln}x{ln})",
+                round(cups, 1), "cell-updates/s", None,
+                {"rulestring": lrule.rulestring,
+                 "seed": CONV_LENIA_SEED,
+                 "turns": CONV_LENIA_TURNS, "tier": tier,
+                 "elapsed_s": round(elapsed, 4),
+                 "oracle_digest": digest, "digest_ok": digest_ok,
+                 "max_abs_err": err, "tol": CONV_LENIA_TOL,
+                 "policy": conv_ops.select_tier(
+                     ln, ln, lrule.radius, "float32",
+                     allowed=("conv", "fft")),
+                 "alive_count": lenia_mod.alive_count_np(
+                     np.asarray(out)),
+                 "parity_check": f"{CONV_LENIA_TURNS}-turn max-abs "
+                                 f"tolerance vs float64 numpy oracle "
+                                 f"+ pinned oracle digest"})
+    finally:
+        if prev_fuse is None:
+            os.environ.pop("GOL_FUSE_K", None)
+        else:
+            os.environ["GOL_FUSE_K"] = prev_fuse
+    return rc
+
+
 def bench_generations(n: int, turns: int,
                       rulestring: str = "/2/3") -> int:
     """Opt-in leg (`--gen [--gen-rule R]`): a 3- or 4-state rule on its
@@ -3405,6 +3641,16 @@ def main() -> int:
                     help="with --mesh: comma-separated mesh widths "
                          "(default 2,4,8; widths beyond the device "
                          "count are skipped with a note)")
+    ap.add_argument("--conv", action="store_true",
+                    help="run the kernel-tier crossover legs only: "
+                         f"radius sweep r={list(CONV_RADII)} at "
+                         f"{CONV_N}² across bitplane/fused/conv/fft "
+                         "(binary legs parity-gated bit-identical vs "
+                         "the numpy summed-area oracle, auto-select "
+                         "policy gated within "
+                         f"{CONV_WITHIN_PCT:g}% of the measured "
+                         "winner) plus pinned-seed Lenia legs "
+                         "(combine with --size/--turns)")
     ap.add_argument("--fuse", action="store_true",
                     help="run the temporal-fusion k-sweep legs only: "
                          "dense boards + 1-D mesh legs, every k "
@@ -3514,6 +3760,7 @@ def _dispatch(args, ap) -> int:
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
                 or args.mesh or args.migrate or args.journal \
+                or args.conv \
                 or args.size is not None \
                 or args.turns is not None:
             ap.error("--federation is its own config; it takes no "
@@ -3524,7 +3771,7 @@ def _dispatch(args, ap) -> int:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
-                or args.mesh or args.journal \
+                or args.mesh or args.journal or args.conv \
                 or args.size is not None \
                 or args.turns is not None:
             ap.error("--migrate is its own config; it takes no "
@@ -3535,7 +3782,7 @@ def _dispatch(args, ap) -> int:
         if args.pattern != "dense" or args.gen or args.engine \
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
-                or args.mesh or args.journal \
+                or args.mesh or args.journal or args.conv \
                 or args.size is not None \
                 or args.turns is not None:
             ap.error("--fleet-obs is its own config; it takes no "
@@ -3547,6 +3794,7 @@ def _dispatch(args, ap) -> int:
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
                 or args.mesh or args.fuse or args.broadcast \
+                or args.conv \
                 or args.size is not None:
             ap.error("--journal is its own config; combine only with "
                      "--turns")
@@ -3558,10 +3806,22 @@ def _dispatch(args, ap) -> int:
                 or args.ksweep or args.wire or args.overhead \
                 or args.chaos or args.fleet or args.load \
                 or args.mesh or args.fuse or args.broadcast \
+                or args.conv \
                 or args.size is not None or args.turns is not None:
             ap.error("--usage is its own config; it takes no other "
                      "leg flags")
         return bench_usage()
+
+    if args.conv:
+        if args.pattern != "dense" or args.gen or args.engine \
+                or args.ksweep or args.wire or args.overhead \
+                or args.load or args.chaos or args.fleet \
+                or args.mesh or args.fuse or args.broadcast:
+            ap.error("--conv is its own config; combine only with "
+                     "--size/--turns")
+        return bench_conv(
+            n=args.size if args.size is not None else CONV_N,
+            turns_override=args.turns or 0)
 
     if args.fuse:
         if args.pattern != "dense" or args.gen or args.engine \

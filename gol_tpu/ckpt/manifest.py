@@ -32,7 +32,7 @@ _TURN_DIGITS = 12
 
 # Representations a manifest may declare: the dense engine's four (see
 # engine.py `_repr`) plus the sparse engine's window state.
-KNOWN_REPRS = ("packed", "u8", "gen8", "gen3", "sparse")
+KNOWN_REPRS = ("packed", "u8", "gen8", "gen3", "sparse", "f32")
 
 
 class CheckpointIntegrityError(ValueError):

@@ -53,9 +53,10 @@ class GeometryMismatch(ValueError):
 class Canonical:
     """One checkpoint decoded to its exact host-side state.
 
-    kind is "life" ({0,1} board01), "gen" (Generations state bytes) or
-    "pixels" (raw u8 pixels whose interpretation the target engine's
-    rule decides — the legacy `world` member round-trips verbatim)."""
+    kind is "life" ({0,1} board01), "gen" (Generations state bytes),
+    "float" (continuous float32 state — Lenia, PR 20) or "pixels"
+    (raw u8 pixels whose interpretation the target engine's rule
+    decides — the legacy `world` member round-trips verbatim)."""
 
     __slots__ = ("kind", "board", "turn", "rule")
 
@@ -112,6 +113,12 @@ def load_canonical(payload_path: str) -> Canonical:
             if state.ndim != 2:
                 raise ValueError("gen_state must be 2-D")
             return Canonical("gen", state, turn, rule)
+        if "float_state" in z:
+            state = np.ascontiguousarray(z["float_state"],
+                                         dtype=np.float32)
+            if state.ndim != 2:
+                raise ValueError("float_state must be 2-D")
+            return Canonical("float", state, turn, rule)
         if "words" in z:
             words = np.ascontiguousarray(z["words"], dtype=np.uint32)
             width = int(z["width"])
@@ -128,7 +135,8 @@ def load_canonical(payload_path: str) -> Canonical:
             return Canonical("pixels", world, turn, rule)
     raise ValueError(
         f"{payload_path}: no decodable payload member (expected one of "
-        f"sparse_words / gen_planes / gen_state / words / world)")
+        f"sparse_words / gen_planes / gen_state / float_state / words / "
+        f"world)")
 
 
 def board01_of(can: Canonical) -> np.ndarray:
@@ -137,6 +145,10 @@ def board01_of(can: Canonical) -> np.ndarray:
         return can.board
     if can.kind == "pixels":
         return (can.board != 0).astype(np.uint8)
+    if can.kind == "float":
+        raise GeometryMismatch(
+            "continuous float state has no binary-board form; restore "
+            "it onto an engine running its own (Lenia) rule")
     raise GeometryMismatch(
         "Generations state has no binary-board form; reshard it onto a "
         "Generations engine with the same rule family")
@@ -182,6 +194,15 @@ def restore_delta(manifest: dict, engine) -> List[str]:
     gdev = geo.get("devices")
     if mdev and gdev and int(mdev) != int(gdev):
         deltas.append(f"mesh devices {mdev} -> {gdev}")
+    # Cell-dtype family (PR 20): a float32 (Lenia) payload must not be
+    # bit-reinterpreted into a binary engine or vice versa. The
+    # manifest's dtype is the PAYLOAD dtype (uint32 words for packed),
+    # so the comparison is float-vs-integer family, not exact dtype.
+    mdtype = str(manifest.get("dtype", ""))
+    gdtype = str(geo.get("dtype", ""))
+    if mdtype and gdtype and \
+            mdtype.startswith("float") != gdtype.startswith("float"):
+        deltas.append(f"cell dtype {mdtype} -> {gdtype}")
     return deltas
 
 
@@ -210,6 +231,12 @@ def write_repacked(can: Canonical, engine, out_path: str) -> None:
         return
     if can.kind == "gen":
         np.savez(out_path, gen_state=can.board, **meta)
+        return
+    if can.kind == "float":
+        # The float board is placement-invariant state; the target
+        # engine's own load_checkpoint enforces that its rule family
+        # can actually hold it.
+        np.savez(out_path, float_state=can.board, **meta)
         return
     if can.kind == "pixels":
         np.savez(out_path, world=can.board, **meta)

@@ -110,6 +110,10 @@ def payload_arrays(host: np.ndarray, repr_: str, extra: dict) -> dict:
     if repr_ == "sparse":
         return {"sparse_words": host, "ox": int(extra["ox"]),
                 "oy": int(extra["oy"]), "size": int(extra["size"])}
+    if repr_ == "f32":
+        # Continuous boards (Lenia) checkpoint their exact float32
+        # state; a pixel quantization would corrupt the dynamics.
+        return {"float_state": host.astype(np.float32, copy=False)}
     # u8 {0,1} cells -> the legacy {0,255} pixel format.
     return {"world": (host * np.uint8(255)).astype(np.uint8)}
 
@@ -123,6 +127,10 @@ def _alive_count(host: np.ndarray, repr_: str) -> int:
         return int(_POP8[host[0].view(np.uint8)].sum(dtype=np.int64))
     if repr_ == "gen8":
         return int((host == 1).sum(dtype=np.int64))
+    if repr_ == "f32":
+        from gol_tpu.models.lenia import ALIVE_THRESHOLD
+
+        return int((host > ALIVE_THRESHOLD).sum(dtype=np.int64))
     return int(host.sum(dtype=np.int64))
 
 

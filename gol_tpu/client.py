@@ -80,6 +80,16 @@ class GeometryRefused(RuntimeError):
     rpc_error_kind = "geometry"
 
 
+class FramesNotDiffable(RuntimeError):
+    """The server refused a delta-view request (basis_turn) because the
+    run's board is not delta-codable — float (Lenia) frames quantize
+    per poll, so an XOR delta against a stale basis would decode to
+    garbage. Recoverable: drop the cached basis and re-poll for a full
+    frame."""
+
+    rpc_error_kind = "nodiff"
+
+
 def _dial(addr, timeout):
     """socket.create_connection behind the chaos dial hook: when
     GOL_CHAOS arms `refuse=p` the hook raises ConnectionRefusedError
@@ -121,6 +131,8 @@ def _check_resp(resp: dict):
             raise _transport_error(err, "moved")
         if err.startswith("geometry:"):
             raise GeometryRefused(err)
+        if err.startswith("nodiff:"):
+            raise FramesNotDiffable(err)
         raise RuntimeError(f"engine error: {err}")
     return resp
 
@@ -152,6 +164,9 @@ class RemoteEngine:
         # keyed by; `_view_basis` is the view frame we already hold.
         self._peer_caps: frozenset = frozenset()
         self._view_basis = None  # (turn, fy, fx, pixels)
+        # Set when the server refuses delta views for this run (float
+        # boards, "nodiff:"): stop declaring a basis on later polls.
+        self._view_nodiff = False
 
     @property
     def peer_caps(self) -> frozenset:
@@ -524,11 +539,22 @@ class RemoteEngine:
                   "vkey": self._token}
         xb = None
         basis = self._view_basis
-        if basis is not None and wire.CAP_XRLE in self._peer_caps:
+        if (basis is not None and not self._view_nodiff
+                and wire.CAP_XRLE in self._peer_caps):
             header["basis_turn"] = basis[0]
             xb = (basis[0], basis[3])
-        resp, view = self._call(header, timeout=self._timeout,
-                                xrle_basis=xb)
+        try:
+            resp, view = self._call(header, timeout=self._timeout,
+                                    xrle_basis=xb)
+        except FramesNotDiffable:
+            # Float (Lenia) boards: deltas are refused by contract.
+            # Drop the basis and re-poll once for a full frame; the
+            # sticky flag stops later polls from declaring a basis
+            # (one refused RPC per run, not one per poll).
+            self._view_nodiff = True
+            self._view_basis = None
+            header.pop("basis_turn", None)
+            resp, view = self._call(header, timeout=self._timeout)
         turn = int(resp["turn"])
         fy, fx = int(resp["fy"]), int(resp["fx"])
         if view is not None:

@@ -49,6 +49,8 @@ import numpy as np
 from gol_tpu import journal as journal_mod
 from gol_tpu.fleet.handles import SingleRunSurface
 from gol_tpu.models.generations import GenerationsRule
+from gol_tpu.models.largerthanlife import LargerThanLifeRule
+from gol_tpu.models.lenia import ALIVE_THRESHOLD, LeniaRule
 from gol_tpu.models.lifelike import CONWAY
 from gol_tpu.obs import catalog as obs
 from gol_tpu.obs import devstats as obs_devstats
@@ -161,6 +163,11 @@ def _firing_row_counts(cells, repr_: str):
                        dtype=jnp.int32)
     if repr_ == "gen8":
         return jnp.sum((cells == 1).astype(jnp.int32), axis=-1)
+    if repr_ == "f32":
+        # Continuous boards (Lenia): "firing" is mass above the
+        # documented telemetry threshold (models/lenia.py).
+        return jnp.sum((cells > ALIVE_THRESHOLD).astype(jnp.int32),
+                       axis=-1)
     return jnp.sum(cells, axis=-1, dtype=jnp.int32)
 
 
@@ -272,6 +279,12 @@ def _view_program(repr_: str, pad: int, f: int, rule):
                     * jnp.uint8(255)).astype(jnp.uint8)
         if repr_ == "u8":
             return (block_max(core) * jnp.uint8(255)).astype(jnp.uint8)
+        if repr_ == "f32":
+            # Continuous state quantized to the brightest mass of each
+            # block — the live view is presentation, so the lossy /255
+            # quantization is fine here (snapshots stay float).
+            px = jnp.clip(jnp.rint(block_max(core) * 255.0), 0.0, 255.0)
+            return px.astype(jnp.uint8)
         if repr_ == "gen8":
             from gol_tpu.models.generations import gray_levels
 
@@ -713,6 +726,52 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                 else:
                     run = sharded_generations_run_turns
                 cells = shard_board(state, mesh)
+        elif isinstance(self._rule, (LargerThanLifeRule, LeniaRule)):
+            # Conv/FFT kernel-tier families (PR 20): the large-radius
+            # neighborhood sum has no bitplane form and no halo
+            # machinery, so these boards run single-shard; the tier
+            # policy (`ops/conv.select_tier`) picks direct-space conv
+            # or FFT per (board, radius, dtype) and the choice rides
+            # the run-fn identity through the jit caches.
+            import warnings
+
+            from gol_tpu.ops import conv as conv_ops
+
+            if self._mesh_shape is not None:
+                warnings.warn(
+                    f"2-D mesh request {self._mesh_shape} ignored (the "
+                    f"conv/FFT kernel tier runs single-shard)")
+            if requested > 1:
+                warnings.warn(
+                    f"{requested} shards requested for rule "
+                    f"{self._rule.rulestring}; the conv/FFT kernel tier "
+                    f"has no halo machinery — running single-shard")
+            mesh = make_mesh(1, self._devices)
+            if isinstance(self._rule, LeniaRule):
+                repr_ = "f32"
+                if world.dtype == np.float32:
+                    state = np.clip(np.ascontiguousarray(world),
+                                    0.0, 1.0)
+                else:
+                    # u8 pixel ingest (the wire's universal codec):
+                    # the state arrives quantized to /255 levels.
+                    state = (np.asarray(world, dtype=np.float32)
+                             / np.float32(255.0))
+                alive0 = int((state > ALIVE_THRESHOLD).sum())
+                tier = conv_ops.select_tier(
+                    height, width, self._rule.radius, "float32",
+                    allowed=("conv", "fft"))
+                run = conv_ops.lenia_run_fn(tier)
+                cells = shard_board(state, mesh)
+            else:
+                repr_ = "u8"
+                alive0 = int(np.count_nonzero(np.asarray(world)))
+                tier = conv_ops.select_tier(
+                    height, width, self._rule.radius, "uint8",
+                    allowed=("conv", "fft"))
+                run = conv_ops.ltl_run_fn(tier)
+                cells = shard_board(from_pixels(world), mesh)
+            conv_ops.note_dispatch(tier)
         else:
             packed, run = select_representation(width)
             repr_ = "packed" if packed else "u8"
@@ -1522,6 +1581,10 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             return packed_alive_count(cells)
         if repr_ == "u8":
             return alive_count_exact(cells)
+        if repr_ == "f32":
+            rows = _padded_row_counts("f32", 0)(cells)
+            return int(np.asarray(jax.device_get(rows),
+                                  dtype=np.int64).sum())
         if repr_ == "gen8":
             from gol_tpu.models.generations import state_alive_count
 
@@ -1536,15 +1599,21 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
     # Dense views/snapshots are board-anchored: two frames of the same
     # shape from the same run are always comparable, so the wire layer
     # may delta-encode them (contrast SparseEngine, whose frames are
-    # window-anchored and drift with the pattern).
-    frames_diffable = True
+    # window-anchored and drift with the pattern). Float boards (Lenia)
+    # are the exception: their authoritative state is float32 and the
+    # u8 views are lossy quantizations, so xrle deltas against them
+    # would silently compound quantization error — not diffable.
+    @property
+    def frames_diffable(self) -> bool:
+        return self._repr != "f32"
 
     @property
     def binary_pixels(self) -> bool:
         """True iff snapshots materialize as strict {0,255} pixels — the
-        precondition for the wire's bit-packed codec. Generations boards
-        carry gray levels and must never be packed."""
-        return not isinstance(self._rule, GenerationsRule)
+        precondition for the wire's bit-packed codec. Generations and
+        continuous (Lenia) boards carry gray levels and must never be
+        packed."""
+        return not isinstance(self._rule, (GenerationsRule, LeniaRule))
 
     def get_world_frame(self, caps) -> Tuple["object", int]:
         """(wire.Frame, completed turn): the codec-framed snapshot path
@@ -1578,6 +1647,12 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             # either way the full-board to_pixels dispatch is gone.
             return wire.u8_band_frame(h, w, bands, caps, binary=True,
                                       values01=True), turn
+        if repr_ == "f32":
+            # Lossless float frame when the peer negotiated it; the
+            # quantized u8 pixel view (the universal codec) otherwise.
+            if wire.CAP_F32 in caps:
+                state = self._float_state(cells, pad)
+                return wire.encode_board_f32(state, caps), turn
         px = self._materialize(cells, repr_, pad)
         return wire.encode_board(px, caps, binary=False), turn
 
@@ -1666,6 +1741,11 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             geo["h"] = int(cells.shape[-2] - pad)
             geo["w"] = int(_board_width(cells, repr_))
             geo["repr"] = repr_
+            # Logical CELL dtype, not storage dtype (packed boards hold
+            # uint32 words of uint8 cells): the reshard-at-restore delta
+            # refuses a float checkpoint on a binary engine and vice
+            # versa without an explicit reshard.
+            geo["dtype"] = "float32" if repr_ == "f32" else "uint8"
         return geo
 
     def _ckpt_snapshot(self, trigger: str = "manual"):
@@ -1756,6 +1836,11 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             }
         elif repr_ == "gen8":
             arrays = {"gen_state": np.asarray(jax.device_get(cells))}
+        elif repr_ == "f32":
+            # Float boards checkpoint their exact state — quantizing to
+            # pixels would silently lose the continuous dynamics.
+            arrays = {"float_state": np.asarray(
+                jax.device_get(cells), dtype=np.float32)}
         else:
             arrays = {"world": np.asarray(
                 jax.device_get(to_pixels(cells)))}
@@ -1817,6 +1902,24 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                         f"{path}: bad Generations state checkpoint "
                         f"({state.dtype} {state.shape})")
                 cells, repr_ = jax.device_put(state), "gen8"
+            elif "float_state" in z.files:
+                state = z["float_state"]
+                if not isinstance(self._rule, LeniaRule):
+                    raise ValueError(
+                        f"{path}: float-state checkpoint needs a "
+                        f"continuous-family engine, not "
+                        f"{self._rule.rulestring}")
+                if state.dtype != np.float32 or state.ndim != 2:
+                    raise ValueError(
+                        f"{path}: bad float-state checkpoint "
+                        f"({state.dtype} {state.shape}); continuous "
+                        f"boards are stored as 2-D float32")
+                if not np.all(np.isfinite(state)):
+                    raise ValueError(
+                        f"{path}: float-state checkpoint carries "
+                        f"non-finite values")
+                cells = jax.device_put(np.clip(state, 0.0, 1.0))
+                repr_ = "f32"
             elif "words" in z.files:
                 # Packed-native checkpoint: no unpack/repack round trip.
                 words = z["words"]
@@ -1846,6 +1949,19 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                     cells = jax.device_put(
                         from_pixels_gen(world, self._rule))
                     repr_ = "gen8"
+                elif isinstance(self._rule, LeniaRule):
+                    # The /255 pixel decode is lossy; a float board's
+                    # checkpoint always carries float_state, so a pixel
+                    # file here is the wrong artifact, not a fallback.
+                    raise ValueError(
+                        f"{path}: pixel checkpoint cannot restore a "
+                        f"continuous float board losslessly (want a "
+                        f"float_state checkpoint)")
+                elif isinstance(self._rule, LargerThanLifeRule):
+                    # Conv-tier binary family: unpacked {0,1} cells
+                    # (the conv kernels have no packed form).
+                    cells = jax.device_put(from_pixels(world))
+                    repr_ = "u8"
                 else:
                     packed, _ = select_representation(width)
                     cells01 = from_pixels(world)
@@ -1925,6 +2041,15 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
                 f"{r}x{c} evenly")
         return make_mesh2d((r, c), self._devices)
 
+    @staticmethod
+    def _float_state(cells, pad: int) -> np.ndarray:
+        """Device f32 state handle -> exact host float32 array (pad
+        rows cropped) — the lossless counterpart of `_materialize` for
+        the float wire frame and checkpoint paths."""
+        if pad:
+            cells = cells[..., : cells.shape[-2] - pad, :]
+        return np.asarray(jax.device_get(cells), dtype=np.float32)
+
     def _snapshot(self) -> Tuple[np.ndarray, int]:
         with self._state_lock:
             cells, turn, repr_ = self._cells, self._turn, self._repr
@@ -1944,6 +2069,12 @@ class Engine(SingleRunSurface, ControlFlagProtocol):
             return np.asarray(jax.device_get(to_pixels(unpack(cells))))
         if repr_ == "u8":
             return np.asarray(jax.device_get(to_pixels(cells)))
+        if repr_ == "f32":
+            # Quantized presentation of continuous state (the float
+            # frame/checkpoint paths read `_float_state` instead).
+            state = np.asarray(jax.device_get(cells))
+            return np.clip(np.rint(state * 255.0), 0, 255
+                           ).astype(np.uint8)
         from gol_tpu.models.generations import to_pixels_gen
 
         if repr_ == "gen3":

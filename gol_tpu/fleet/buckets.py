@@ -115,6 +115,19 @@ def choose_placement(hb: int, wb: int, slot_base: int,
     return "batch"
 
 
+def cell_dtype(rule) -> str:
+    """Logical cell dtype of a rule family — the bucket key's fourth
+    element (PR 20). Binary families ("bit": life-like today; LtL would
+    pack the same way) share the packed-words bucket machinery; a
+    float-state (Lenia) board is "float32" and may NEVER land in a
+    packed bucket, even if two families someday collide on rulestring
+    text. Keying on dtype makes that a structural impossibility rather
+    than a convention."""
+    from gol_tpu.models.lenia import LeniaRule
+
+    return "float32" if isinstance(rule, LeniaRule) else "bit"
+
+
 def choose_bucket_size(h: int, w: int,
                        sizes: Sequence[int]) -> Optional[int]:
     """Smallest configured bucket class the (h, w) board tiles exactly,

@@ -60,6 +60,7 @@ from gol_tpu.fleet.buckets import (
     DEFAULT_BUCKET_SIZES,
     DEFAULT_SLOT_BASE,
     board_to_words,
+    cell_dtype,
     choose_bucket_size,
     choose_placement,
     private_shape,
@@ -327,7 +328,8 @@ class FleetEngine(ControlFlagProtocol):
 
         handle = RunHandle(run_id, run_rule, h, w, ckpt_every=ckpt_every,
                            target_turn=target_turn)
-        handle.bucket_key = (size, size, run_rule.rulestring)
+        handle.bucket_key = (size, size, run_rule.rulestring,
+                             cell_dtype(run_rule))
         handle.frozen = board01
         handle.admitted_cost = cost
         with self._fleet_lock:
@@ -451,7 +453,8 @@ class FleetEngine(ControlFlagProtocol):
                            ckpt_every=int(ckpt_every),
                            target_turn=target_turn,
                            start_turn=int(m["turn"]))
-        handle.bucket_key = (size, size, run_rule.rulestring)
+        handle.bucket_key = (size, size, run_rule.rulestring,
+                             cell_dtype(run_rule))
         handle.admitted_cost = cost
         # Born quarantined: no trusted board yet. The fleet loop's
         # restore path verifies + loads the checkpoint and queues the
@@ -647,7 +650,8 @@ class FleetEngine(ControlFlagProtocol):
                            ckpt_every=int(ckpt_every),
                            target_turn=target_turn,
                            start_turn=int(turn))
-        handle.bucket_key = (size, size, run_rule.rulestring)
+        handle.bucket_key = (size, size, run_rule.rulestring,
+                             cell_dtype(run_rule))
         handle.frozen = board01
         handle.alive = int(board01.sum())
         handle.alive_turn = handle.turn
@@ -773,9 +777,10 @@ class FleetEngine(ControlFlagProtocol):
                 self._placeq.append(h)
             # Parked runs stay parked, slotless: _resume_locked requeues
             # them through placement when a drive resumes them.
-        hb, wb, _old = h.bucket_key
+        hb, wb = h.bucket_key[:2]
         h.rule = new_rule
-        h.bucket_key = (hb, wb, new_rule.rulestring)
+        h.bucket_key = (hb, wb, new_rule.rulestring,
+                        cell_dtype(new_rule))
         obs_log("fleet.rule_migrated", run_id=h.run_id,
                 rule=new_rule.rulestring, turn=h.turn, state=h.state)
 
@@ -788,7 +793,10 @@ class FleetEngine(ControlFlagProtocol):
             rule = parse_rule(rule)
         if not isinstance(rule, LifeLikeRule):
             self.admission.reject("rule")
-            raise RuntimeError("admission rejected: rule (life-like only)")
+            raise RuntimeError(
+                "admission rejected: rule (the fleet batches the packed "
+                "life-like stencil; LtL/Lenia runs are served by the "
+                "dense engine's conv/FFT tier)")
         return rule
 
     @staticmethod
@@ -863,7 +871,8 @@ class FleetEngine(ControlFlagProtocol):
                                    start_turn=start_turn)
                 size = choose_bucket_size(h, w, self.bucket_sizes)
                 hb, wb = (size, size) if size else private_shape(h, w)
-                handle.bucket_key = (hb, wb, self._rule.rulestring)
+                handle.bucket_key = (hb, wb, self._rule.rulestring,
+                                     cell_dtype(self._rule))
                 handle.frozen = self._board01(world, h, w)
                 # Legacy runs predate admission: never rejected, never
                 # charged (admitted_cost stays 0).
@@ -1415,7 +1424,16 @@ class FleetEngine(ControlFlagProtocol):
         key = h.bucket_key
         bucket = self._buckets.get(key)
         if bucket is None:
-            hb, wb, _rs = key
+            hb, wb, _rs, dtype = key
+            if dtype != "bit":
+                # Defense in depth: admission already rejects non-
+                # binary families (the dense engine's conv/FFT tier
+                # serves them), but a float board reaching placement
+                # must never be bit-packed into a Bucket.
+                raise FleetUnsupported(
+                    f"no fleet bucket class for cell dtype {dtype!r}; "
+                    "float-state (Lenia) runs are served by the dense "
+                    "engine's conv/FFT kernel tier")
             ndev = len(self._devices)
             placement = choose_placement(hb, wb, self.slot_base, ndev)
             if placement == "batch":

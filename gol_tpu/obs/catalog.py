@@ -85,6 +85,28 @@ ENGINE_BAND_COPIES = REGISTRY.counter(
     "(engine._banded_device_rows); stays flat while no viewer or "
     "snapshot consumer is attached.")
 
+# ---------------------------------------------------------- kernel tiers
+
+# Every tier the conv-family dispatch can select (ops/conv.TIERS
+# mirrors this; pre-seeded so /metrics always shows the full matrix).
+KERNEL_TIERS = ("bitplane", "fused", "conv", "fft")
+
+KERNEL_TIER = REGISTRY.gauge(
+    "gol_kernel_tier",
+    "One-hot active kernel tier of the most recent conv-family "
+    "dispatch: the selected tier reads 1, every other 0 "
+    "(ops/conv.select_tier policy; GOL_KERNEL_TIER forces).",
+    label_names=("tier",))
+CONV_DISPATCHES = REGISTRY.counter(
+    "gol_conv_dispatches_total",
+    "Conv-family kernel dispatches (LtL / Lenia run submissions and "
+    "standalone run_turns calls), by selected tier.",
+    label_names=("tier",))
+
+for _t in KERNEL_TIERS:
+    KERNEL_TIER.labels(tier=_t)
+    CONV_DISPATCHES.labels(tier=_t)
+
 # ------------------------------------------------------------ wire bytes
 
 WIRE_BYTES = REGISTRY.counter(
@@ -104,7 +126,8 @@ for _d in ("sent", "received"):
 # Every codec the framing layer can put on the wire (wire.CODECS mirrors
 # this; the tuple lives here so the catalogue stays import-light) —
 # pre-seeded like the methods so /metrics always shows the full matrix.
-WIRE_CODECS = ("u8", "packed", "u8+zlib", "packed+zlib", "xrle")
+WIRE_CODECS = ("u8", "packed", "u8+zlib", "packed+zlib", "xrle",
+               "f32", "f32+zlib")
 
 WIRE_FRAMES = REGISTRY.counter(
     "gol_wire_frames_total",

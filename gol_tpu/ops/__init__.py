@@ -7,6 +7,11 @@ from gol_tpu.ops.stencil import (
     to_pixels,
 )
 
+# The conv/FFT kernel tier (`gol_tpu.ops.conv`) is intentionally NOT
+# imported here: it pulls in the obs catalogue and jit machinery, and
+# every consumer (engine family branches, bench, fleet) imports it
+# lazily at the dispatch site.
+
 __all__ = [
     "alive_count",
     "from_pixels",

@@ -1,0 +1,470 @@
+"""Convolution/FFT stencil tier — large-radius neighborhood sums.
+
+Every other kernel in the repo is radius-1 bitplane arithmetic
+(`ops/bitpack.py`, `ops/fused.py`): per-cell work is O(1) there, but a
+general radius-r neighborhood sum costs O(r²) shift-adds and the
+bitplane tiers fall off a cliff the moment r grows past a few cells.
+This module adds the two tiers that win beyond that point:
+
+* **conv** — direct-space circular convolution, one fused XLA
+  program. Separable kernels (the LtL Moore box) run as two 1-D
+  shift-add accumulations (O(r) streaming adds per cell — each add is
+  a full-board vectorized pass at memory bandwidth); general kernels
+  go through `lax.conv_general_dilated` over a wrap-padded board.
+  The conv tier wins the middle radii where the bitplane's
+  bit-parallel advantage is gone but its O(r) per-cell work is still
+  below the FFT's fixed O(log n).
+* **fft** — circular convolution by the convolution theorem:
+  `irfft2(rfft2(board) * Kspec)` with the kernel spectrum `Kspec`
+  precomputed ONCE per (shape, kernel) and cached (the PR-4
+  step-signature counter witnesses the reuse — stepping the same
+  config twice must not mint a new signature). Per-cell cost is
+  O(log n) independent of r: the large-radius regime ("Fast Stencil
+  Computations using Fast Fourier Transforms", PAPERS.md).
+
+Integer exactness through the FFT (the bit-identical parity gates):
+the board's mean is subtracted before the forward transform and the
+kernel-sum compensation is added back after the inverse —
+conv(b, k) == conv(b - mean, k) + mean·sum(k) — which removes the DC
+term that dominates float32 round-off on big boards (at 4096² the DC
+bin holds ~4e6 while the AC bins hold ~2e3; without the split the
+round-off at r=32 approaches 0.5 and rounding would flip counts).
+With the split, `rint` recovers the exact integer neighborhood sums;
+`bench.py --conv` gates that bit-identically against an independent
+numpy summed-area-table oracle at every swept radius.
+
+Tier selection (`GOL_KERNEL_TIER=auto|bitplane|fused|conv|fft`) is
+policy, not mechanism: `select_tier` picks per (board, radius, dtype)
+from the measured crossover table `CROSSOVER_FFT_RADIUS` (refreshed by
+`bench.py --conv`, which gates that the policy picks the measured
+winner at every swept radius). Callers that only implement a subset of
+tiers (the engine's conv families have no bitplane form) pass
+`allowed=` to clamp the answer.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gol_tpu.obs import catalog as obs
+from gol_tpu.obs import devstats as obs_devstats
+
+TIER_ENV = "GOL_KERNEL_TIER"
+TIERS = ("bitplane", "fused", "conv", "fft")
+
+# Measured crossover table (bench.py --conv, CPU host, box kernels,
+# measured in the real dispatch form — the jitted scan the engine and
+# bench both run): radius at or above which the FFT tier beats the
+# direct-space conv tier, keyed by board area ceiling. The conv
+# tier's cost grows as O(r) per cell (separable shift-add) while the
+# FFT's is flat in r, so the table is a single threshold per area
+# band. Measured per-turn anchors: 1024² r=8 conv 13.3 ms vs fft
+# 15.5 ms (conv wins), r=16 conv 21.9 vs fft 12.8 (fft wins); 4096²
+# r=8 conv 242 ms vs fft 352, r=16 conv 440 vs fft 353 — crossover
+# ~12 across the mid/large bands. Tiny boards are dispatch-dominated
+# (both tiers < 1 ms at 256²) and cross earlier.
+# GOL_CONV_CROSSOVER=<radius> overrides (operators on different hosts
+# re-measure via bench.py --conv and pin what they saw).
+CROSSOVER_ENV = "GOL_CONV_CROSSOVER"
+CROSSOVER_FFT_RADIUS = (
+    # (max board area, fft wins at radius >=)
+    (1 << 17, 7),    # <= ~256²: dispatch-latency floor, early cross
+    (1 << 63, 12),   # 1024²..4096² anchors and beyond
+)
+
+
+def _crossover_radius(area: int) -> int:
+    raw = os.environ.get(CROSSOVER_ENV, "").strip()
+    if raw:
+        try:
+            return max(2, int(raw))
+        except ValueError:
+            pass  # fall through to the measured table
+    for max_area, r in CROSSOVER_FFT_RADIUS:
+        if area <= max_area:
+            return r
+    return CROSSOVER_FFT_RADIUS[-1][1]
+
+
+def select_tier(h: int, w: int, radius: int, dtype: str = "uint8",
+                allowed: Sequence[str] = TIERS) -> str:
+    """The kernel tier for one (board, radius, dtype) — the ONE policy
+    point every conv-family dispatch resolves through.
+
+    `dtype` is the CELL dtype ("uint8" for binary boards, "float32"
+    for continuous state); float boards have no bitplane form, so the
+    binary-only tiers are never selected for them. `allowed` clamps to
+    the tiers the caller actually implements (the engine's conv
+    families run conv/fft only; bench sweeps all four)."""
+    allowed = tuple(t for t in TIERS if t in allowed)
+    if not allowed:
+        raise ValueError("no kernel tiers allowed")
+    forced = os.environ.get(TIER_ENV, "auto").strip().lower() or "auto"
+    if forced != "auto":
+        if forced not in TIERS:
+            raise ValueError(
+                f"bad {TIER_ENV}={forced!r}: want auto|" + "|".join(TIERS))
+        if forced in allowed:
+            return forced
+        # A forced tier the caller can't run (bitplane on a float
+        # board) falls through to auto rather than crashing the run —
+        # loudly, mirroring the engine's mesh-fallback stance.
+        import warnings
+
+        warnings.warn(
+            f"{TIER_ENV}={forced} unavailable here (allowed: "
+            f"{allowed}); auto-selecting instead")
+    binary = str(dtype) in ("uint8", "uint32", "bool")
+    if binary and radius <= 1:
+        from gol_tpu.ops.fused import configured_fuse_k
+
+        if "fused" in allowed and configured_fuse_k() > 1:
+            return "fused"
+        if "bitplane" in allowed:
+            return "bitplane"
+    if "fft" not in allowed:
+        return "conv"
+    if "conv" not in allowed:
+        return "fft"
+    if not binary:
+        # Float boards mean dense smooth kernels (Lenia): there is no
+        # separable shift-add form, so the direct tier pays O(r²) taps
+        # through conv_general_dilated and the FFT wins at every radius
+        # Lenia admits (measured at 1024²: r=2 conv 163 ms vs fft
+        # 18 ms, widening with r). The box-kernel crossover table below
+        # does not apply; conv stays reachable via GOL_KERNEL_TIER=conv.
+        return "fft"
+    return "fft" if radius >= _crossover_radius(h * w) else "conv"
+
+
+def note_dispatch(tier: str) -> None:
+    """Meter one conv-family dispatch: the `gol_conv_dispatches_total`
+    counter plus the one-hot `gol_kernel_tier` gauge (the active tier
+    reads 1, every other reads 0 — a flat family a dashboard can
+    legend without decoding an enum)."""
+    obs.CONV_DISPATCHES.labels(tier=tier).inc()
+    for t in TIERS:
+        obs.KERNEL_TIER.labels(tier=t).set(1.0 if t == tier else 0.0)
+
+
+# ------------------------------------------------------------- kernels
+
+
+def neighborhood_kernel(radius: int, kind: str = "M",
+                        middle: bool = False) -> np.ndarray:
+    """(2r+1, 2r+1) float32 {0,1} mask of the neighborhood:
+    'M' Moore box, 'N' von Neumann diamond (|dy|+|dx| <= r),
+    'C' circular (dy² + dx² <= r²). `middle` includes the center cell
+    (the LtL M1 convention: a cell counts itself for survival)."""
+    r = int(radius)
+    if r < 1:
+        raise ValueError(f"radius must be >= 1, got {radius}")
+    dy, dx = np.mgrid[-r:r + 1, -r:r + 1]
+    if kind == "M":
+        mask = np.ones((2 * r + 1, 2 * r + 1), dtype=bool)
+    elif kind == "N":
+        mask = (np.abs(dy) + np.abs(dx)) <= r
+    elif kind == "C":
+        mask = (dy * dy + dx * dx) <= r * r
+    else:
+        raise ValueError(f"unknown neighborhood kind {kind!r}")
+    mask[r, r] = bool(middle)
+    return mask.astype(np.float32)
+
+
+def _embed_kernel(kernel: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Center a (2r+1, 2r+1) kernel into an (h, w) circular-convolution
+    field: tap (dy, dx) lands at index (dy mod h, dx mod w), so
+    out[y, x] = sum_k kernel[k] * board[y - dy_k, x - dx_k] on the
+    torus matches the direct wrap-padded convolution exactly."""
+    kh, kw = kernel.shape
+    r = kh // 2
+    if kh > h or kw > w:
+        raise ValueError(
+            f"kernel {kernel.shape} exceeds board {(h, w)} — a "
+            f"neighborhood wider than the torus would self-overlap")
+    field = np.zeros((h, w), dtype=np.float32)
+    for dy in range(-r, r + 1):
+        for dx in range(-r, r + 1):
+            v = kernel[dy + r, dx + r]
+            if v:
+                field[dy % h, dx % w] += v
+    return field
+
+
+# ------------------------------------------------------- conv tier
+
+
+def _box_center_delta(kern: np.ndarray) -> Optional[float]:
+    """If `kern` is an all-ones box apart from its center tap, return
+    (center − 1) — the separable decomposition box + delta·δ₀. None
+    when the kernel is not a box (disc/diamond/smooth kernels)."""
+    k = np.asarray(kern, dtype=np.float32).copy()
+    r = k.shape[0] // 2
+    center = float(k[r, r])
+    k[r, r] = 1.0
+    if k.shape[0] == k.shape[1] and np.all(k == 1.0):
+        return center - 1.0
+    return None
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_key",))
+def _conv_sum(board, kernel_key) -> jax.Array:
+    """(H, W) float32 board -> float32 circular neighborhood sums as
+    one fused direct-space program. `kernel_key` is the hashable
+    kernel description (see `kernel_from_key`); the taps become
+    compile-time constants of the traced program.
+
+    Box kernels (the common LtL Moore case) take the separable
+    shift-add path: (2r+1)-wide running sums along each axis via
+    torus rolls — 4r+2 vectorized full-board adds total, each at
+    memory bandwidth, bit-exact in any order for integer boards
+    (float32 holds every partial below 2^24). General kernels go
+    through `lax.conv_general_dilated` on a wrap-padded board (the
+    circular boundary), which is the dense O(r²)-taps form."""
+    kern = kernel_from_key(kernel_key)
+    r = kern.shape[0] // 2
+    delta = _box_center_delta(kern)
+    if delta is not None:
+        acc = board
+        for d in range(1, r + 1):
+            acc = acc + jnp.roll(board, d, 0) + jnp.roll(board, -d, 0)
+        out = acc
+        for d in range(1, r + 1):
+            out = out + jnp.roll(acc, d, 1) + jnp.roll(acc, -d, 1)
+        if delta:
+            out = out + jnp.float32(delta) * board
+        return out
+    padded = jnp.pad(board, r, mode="wrap")
+    # NCHW activations / OIHW taps: a single-feature 2-D convolution.
+    out = lax.conv_general_dilated(
+        padded[None, None, :, :].astype(jnp.float32),
+        jnp.asarray(kern)[None, None, :, :],
+        window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return out[0, 0]
+
+
+def conv_neighbor_sum(board, kernel_key) -> jax.Array:
+    """Neighborhood sums through the direct-space conv tier. Exact for
+    integer-valued boards (float32 holds every sum below 2^24)."""
+    return _conv_sum(jnp.asarray(board, dtype=jnp.float32), kernel_key)
+
+
+# -------------------------------------------------------- fft tier
+
+
+@functools.lru_cache(maxsize=64)
+def _fft_spectrum_np(h: int, w: int, kernel_key) -> np.ndarray:
+    """The cached kernel spectrum: rfft2 of the kernel embedded in the
+    (h, w) circular field, computed ONCE per (shape, kernel) in float64
+    and held as complex64 (the board transform is float32; a float64
+    kernel spectrum would just upcast the product). This cache is what
+    the "cached-spectrum reuse" tests witness — a second step of the
+    same config re-uses both this host array and the jitted program
+    below, so the step-signature counter must not move."""
+    kern = kernel_from_key(kernel_key)
+    field = _embed_kernel(kern, h, w)
+    return np.fft.rfft2(field.astype(np.float64)).astype(np.complex64)
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_key",))
+def _fft_sum(board, kernel_key) -> jax.Array:
+    """(H, W) float32 board -> float32 circular neighborhood sums via
+    rfft2/irfft2 with the cached kernel spectrum baked in as a
+    compile-time constant. Mean-split for integer exactness (module
+    docstring): the DC term never rides through the transform."""
+    h, w = board.shape
+    spec = jnp.asarray(_fft_spectrum_np(h, w, kernel_key))
+    ksum = float(kernel_from_key(kernel_key).sum())
+    mean = jnp.mean(board)
+    ac = jnp.fft.irfft2(jnp.fft.rfft2(board - mean) * spec, s=(h, w))
+    return ac + mean * ksum
+
+
+def fft_neighbor_sum(board, kernel_key) -> jax.Array:
+    """Neighborhood sums through the FFT tier (float32 result; callers
+    needing exact integer counts `rint` it — see `_counts`)."""
+    return _fft_sum(jnp.asarray(board, dtype=jnp.float32), kernel_key)
+
+
+# ------------------------------------------------- kernel-key registry
+#
+# jit static args and lru_cache keys must be hashable, so kernels are
+# passed BY DESCRIPTION, not by array: a kernel key is a tuple whose
+# head names the builder. The registry is the one decode point.
+
+
+def kernel_from_key(kernel_key) -> np.ndarray:
+    """Decode a hashable kernel description into its float32 taps.
+
+    Keys:
+      ("ltl", radius, kind, middle)            — {0,1} neighborhood mask
+      ("lenia", radius, "%.6g" % peak_count…)  — see models/lenia.py
+    """
+    head = kernel_key[0]
+    if head == "ltl":
+        _, radius, kind, middle = kernel_key
+        return neighborhood_kernel(radius, kind, middle)
+    if head == "lenia":
+        from gol_tpu.models.lenia import lenia_kernel_from_key
+
+        return lenia_kernel_from_key(kernel_key)
+    raise ValueError(f"unknown kernel key {kernel_key!r}")
+
+
+def neighbor_sum(board, kernel_key, tier: str) -> jax.Array:
+    """Dispatch one neighborhood sum through the named tier."""
+    if tier == "conv":
+        return conv_neighbor_sum(board, kernel_key)
+    if tier == "fft":
+        return fft_neighbor_sum(board, kernel_key)
+    raise ValueError(
+        f"tier {tier!r} has no general-radius neighbor_sum (the "
+        f"bitplane/fused tiers are radius-1 life-like only)")
+
+
+# --------------------------------------------- engine-facing run fns
+#
+# The engine's `_tokened_run` wraps a callable `run(cells, k, mesh,
+# rule)` in the chunk program; these builders return such callables
+# with the tier baked into the FUNCTION IDENTITY (module-level
+# lru_cache), so the engine's jit caches key correctly on the tier —
+# the same stable-identity pattern as `ops/fused.configured run fns`.
+
+
+def _ltl_counts(cells_f32, rule, tier: str) -> jax.Array:
+    """Exact int32 neighborhood counts for a {0,1} board."""
+    s = neighbor_sum(cells_f32, rule.kernel_key, tier)
+    # conv sums are exact already; fft sums carry <0.5 round-off.
+    return jnp.rint(s).astype(jnp.int32)
+
+
+def _ltl_step(cells, rule, tier: str) -> jax.Array:
+    """One Larger-than-Life turn on {0,1} uint8 cells: neighborhood
+    count (center included iff the rule says so) -> interval tests
+    against the rule's survive/born count ranges.
+
+    Interval compares, NOT the uint8 LUT gather the numpy oracle uses:
+    XLA's CPU gather lowers to a generic scalar loop that ran ~40x
+    slower than the whole separable conv sum it consumed (and a rule
+    has a handful of ranges at most, so the compare chain is a few
+    fused vector passes). The oracle keeps the gather form — disjoint
+    mechanisms is exactly what a parity gate wants."""
+    counts = _ltl_counts(cells.astype(jnp.float32), rule, tier)
+
+    def in_ranges(spans):
+        ok = jnp.zeros(counts.shape, dtype=jnp.bool_)
+        for lo, hi in spans:
+            ok = ok | ((counts >= lo) & (counts <= min(hi, 1 << 30)))
+        return ok
+
+    alive = jnp.where(cells == 1, in_ranges(rule.survive_ranges),
+                      in_ranges(rule.born_ranges))
+    return alive.astype(jnp.uint8)
+
+
+@functools.lru_cache(maxsize=8)
+def ltl_run_fn(tier: str):
+    """Engine run fn for the Larger-than-Life family on the given tier
+    (uint8 {0,1} cells, single-shard: `mesh` is accepted for signature
+    parity and must be 1-way — conv families have no halo machinery)."""
+
+    def run(cells, k, mesh, rule):
+        if k == 0:
+            return cells
+
+        def body(c, _):
+            return _ltl_step(c, rule, tier), None
+
+        out, _ = lax.scan(body, cells, None, length=k)
+        return out
+
+    # jit here, not just in the engine's chunk program: standalone
+    # callers (bench/tests) would otherwise re-trace the scan on every
+    # call — ~100 ms of flat overhead that swamps small-board timings.
+    # k/mesh/rule are static (ints, None, hashable frozen dataclass).
+    return jax.jit(run, static_argnums=(1, 2, 3))
+
+
+@functools.lru_cache(maxsize=8)
+def lenia_run_fn(tier: str):
+    """Engine run fn for the Lenia family: float32 state in [0, 1],
+    smooth-kernel neighborhood sum -> growth -> clipped Euler step
+    (models/lenia.py owns the math; this wires it to the tier)."""
+    from gol_tpu.models.lenia import lenia_step
+
+    def run(cells, k, mesh, rule):
+        if k == 0:
+            return cells
+
+        def body(c, _):
+            return lenia_step(c, rule, tier), None
+
+        out, _ = lax.scan(body, cells, None, length=k)
+        return out
+
+    return jax.jit(run, static_argnums=(1, 2, 3))
+
+
+def run_turns(cells, num_turns: int, rule, tier: Optional[str] = None):
+    """Standalone conv-tier turn loop (bench/tests): advance
+    `num_turns` turns of an LtL or Lenia rule on the given tier
+    (auto-selected from the board when None)."""
+    cells = jnp.asarray(cells)
+    h, w = cells.shape[-2], cells.shape[-1]
+    if tier is None:
+        tier = select_tier(h, w, rule.radius, str(cells.dtype),
+                           allowed=("conv", "fft"))
+    from gol_tpu.models.lenia import LeniaRule
+
+    fn = (lenia_run_fn(tier) if isinstance(rule, LeniaRule)
+          else ltl_run_fn(tier))
+    note_dispatch(tier)
+    obs_devstats.note_signature(
+        ("conv", tier, (h, w), str(cells.dtype), rule.rulestring))
+    return fn(cells, num_turns, None, rule)
+
+
+# ---------------------------------------------------- numpy oracle
+
+
+def box_counts_np(board: np.ndarray, radius: int,
+                  middle: bool = False) -> np.ndarray:
+    """Independent O(H·W) oracle for Moore-box neighborhood counts on
+    the torus: wrap-pad + summed-area table, no convolution and no FFT
+    anywhere near it — the bench parity gate's reference even at
+    4096²/r=32 (a direct tap loop there would be 7e10 adds)."""
+    r = int(radius)
+    b = np.pad(np.asarray(board, dtype=np.int64), r, mode="wrap")
+    s = np.zeros((b.shape[0] + 1, b.shape[1] + 1), dtype=np.int64)
+    s[1:, 1:] = b.cumsum(axis=0).cumsum(axis=1)
+    k = 2 * r + 1
+    h, w = board.shape
+    counts = (s[k:k + h, k:k + w] - s[0:h, k:k + w]
+              - s[k:k + h, 0:w] + s[0:h, 0:w])
+    if not middle:
+        counts = counts - np.asarray(board, dtype=np.int64)
+    return counts
+
+
+def counts_np(board: np.ndarray, kernel: np.ndarray) -> np.ndarray:
+    """General-kernel oracle: direct tap accumulation over np.roll
+    shifts. O(H·W·r²) — small boards/radii only (tests)."""
+    kh, kw = kernel.shape
+    r = kh // 2
+    out = np.zeros(board.shape, dtype=np.float64)
+    b = np.asarray(board, dtype=np.float64)
+    for dy in range(-r, r + 1):
+        for dx in range(-r, r + 1):
+            v = float(kernel[dy + r, dx + r])
+            if v:
+                out += v * np.roll(np.roll(b, dy, axis=0), dx, axis=1)
+    return out

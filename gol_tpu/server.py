@@ -616,6 +616,20 @@ class EngineServer:
                 if (hasattr(eng, "subscribe_view")
                         and isinstance(vkey, str) and 0 < len(vkey) <= 64):
                     eng.subscribe_view(vkey)
+                if (header.get("basis_turn") is not None
+                        and not getattr(eng, "frames_diffable", True)):
+                    # Float (Lenia) boards quantize per frame, so an
+                    # XOR delta against the peer's basis would decode
+                    # to garbage. Refuse with a TAGGED error instead —
+                    # the client drops its basis and re-polls for full
+                    # frames. (_encode_view never delta-encodes these;
+                    # this guards the peer's explicit delta request.)
+                    err = RuntimeError(
+                        "this board's frames are not delta-codable "
+                        "(float state); re-poll without basis_turn for "
+                        "full frames")
+                    err.rpc_error_kind = "nodiff"
+                    raise err
                 out, turn, (fy, fx) = eng.get_view(
                     int(header.get("max_cells", 0)))
                 try:
@@ -874,6 +888,13 @@ class EngineServer:
                 # GeometryRefused — resend with reshard=True to repack.
                 self._reply(conn, {"ok": False,
                                    "error": f"geometry: {e}"})
+            elif getattr(e, "rpc_error_kind", None) == "nodiff":
+                # Delta-view request against a non-diffable (float)
+                # board: tagged so the client clears its stale basis
+                # and re-polls full frames instead of surfacing an
+                # error to the viewer.
+                self._reply(conn, {"ok": False,
+                                   "error": f"nodiff: {e}"})
             else:
                 self._reply(conn, {"ok": False,
                                    "error": f"{type(e).__name__}: {e}"})

@@ -25,6 +25,9 @@ Board payloads are framed by a codec named in the world dict:
   xrle           XOR-delta vs the receiver's previous       <= H*W
                  frame ("basis_turn" names it), run-length
                  tokens `<II`(skip, litlen) + litlen bytes
+  f32            raw little-endian float32 state, row-major  H*W*4
+                 (continuous boards — Lenia; PR 20)
+  f32+zlib       zlib(level 1) of the f32 payload           < H*W*4
 
 Raw u8 is the universal fallback: an uncompressed u8 frame's payload is
 exactly H*W bytes and pre-PR-5 receivers ignore unknown world keys, so
@@ -132,15 +135,22 @@ DEFAULT_MAX_BOARD_CELLS = 1 << 35
 CAP_PACKED = "packed"
 CAP_ZLIB = "zlib"
 CAP_XRLE = "xrle"
-SUPPORTED_CAPS = frozenset({CAP_PACKED, CAP_ZLIB, CAP_XRLE})
+# A peer advertising CAP_F32 accepts lossless float32 state frames
+# (continuous boards — Lenia, PR 20). Senders without the flag get the
+# quantized u8 view instead, which every peer ever shipped decodes.
+CAP_F32 = "f32"
+SUPPORTED_CAPS = frozenset({CAP_PACKED, CAP_ZLIB, CAP_XRLE, CAP_F32})
 
 CODEC_U8 = "u8"
 CODEC_PACKED = "packed"
 CODEC_U8_ZLIB = "u8+zlib"
 CODEC_PACKED_ZLIB = "packed+zlib"
 CODEC_XRLE = "xrle"
+CODEC_F32 = "f32"
+CODEC_F32_ZLIB = "f32+zlib"
 CODECS = frozenset({CODEC_U8, CODEC_PACKED, CODEC_U8_ZLIB,
-                    CODEC_PACKED_ZLIB, CODEC_XRLE})
+                    CODEC_PACKED_ZLIB, CODEC_XRLE,
+                    CODEC_F32, CODEC_F32_ZLIB})
 
 ZLIB_LEVEL = 1
 DEFAULT_ZLIB_MAX_BYTES = 64 << 20
@@ -154,7 +164,7 @@ _XRLE_GAP = 16
 # Pre-resolved metric children (PR 6): `.labels(...)` costs a label-set
 # validation, a tuple build, and a family-lock acquisition per call —
 # fine per RPC, not fine per frame on the streaming path. The label
-# spaces here are tiny and closed (2 directions, 5 codecs), so resolve
+# spaces here are tiny and closed (2 directions, 7 codecs), so resolve
 # every child once at import and index a plain dict afterwards.
 _BYTES_SENT = obs.WIRE_BYTES.labels(direction="sent")
 _BYTES_RECV = obs.WIRE_BYTES.labels(direction="received")
@@ -374,7 +384,8 @@ def _build_frame(codec: str, h: int, w: int, nbytes: int, raw_nbytes: int,
     # turn-path test pins to zero.
     obs.WIRE_ENCODE_CALLS.inc()
     frame = Frame(codec, h, w, nbytes, raw_nbytes, None, extra)
-    if CAP_ZLIB in caps and codec in (CODEC_U8, CODEC_PACKED) \
+    if CAP_ZLIB in caps \
+            and codec in (CODEC_U8, CODEC_PACKED, CODEC_F32) \
             and nbytes <= zlib_max_bytes():
         t0 = time.perf_counter()
         co = zlib.compressobj(ZLIB_LEVEL)
@@ -433,6 +444,32 @@ def encode_board(world: np.ndarray, caps: frozenset = frozenset(), *,
     mv = memoryview(payload).cast("B")
     enc = time.perf_counter() - t0
     frame = _build_frame(codec, h, w, nbytes, h * w, caps,
+                         lambda f: iter([mv]))
+    frame.encode_s += enc
+    return frame
+
+
+def encode_board_f32(state: np.ndarray,
+                     caps: frozenset = frozenset()) -> Frame:
+    """Encode one host-resident float32 state board (continuous CA —
+    Lenia) losslessly for the wire: raw little-endian '<f4' bytes,
+    row-major, exactly h*w*4 of them, zlib-layered when negotiated.
+
+    Only sent to peers that advertised CAP_F32 — callers without it
+    must quantize to a u8 view and use `encode_board` instead (the
+    engine's get_world_frame does exactly that)."""
+    if state.ndim != 2:
+        raise ValueError("state must be 2-D float32")
+    if CAP_F32 not in caps:
+        raise ValueError(
+            "peer did not negotiate the f32 capability; send a "
+            "quantized u8 view via encode_board instead")
+    h, w = state.shape
+    t0 = time.perf_counter()
+    payload = np.ascontiguousarray(state, dtype="<f4")
+    mv = memoryview(payload).cast("B")
+    enc = time.perf_counter() - t0
+    frame = _build_frame(CODEC_F32, h, w, h * w * 4, h * w * 4, caps,
                          lambda f: iter([mv]))
     frame.encode_s += enc
     return frame
@@ -702,6 +739,8 @@ def _recv_frame(sock: socket.socket, codec: str, meta: dict, h: int,
         CODEC_U8_ZLIB: (1, h * w - 1),
         CODEC_PACKED_ZLIB: (1, h * wp * 4 - 1),
         CODEC_XRLE: (0, h * w - 1),
+        CODEC_F32: (h * w * 4, h * w * 4),
+        CODEC_F32_ZLIB: (1, h * w * 4 - 1),
     }[codec]
     if not lo <= nbytes <= hi:
         raise WireProtocolError(
@@ -715,8 +754,12 @@ def _recv_frame(sock: socket.socket, codec: str, meta: dict, h: int,
         world = buf.reshape(h, w)
     elif codec == CODEC_PACKED:
         world = _decode_packed(buf, h, w)
-    elif codec in (CODEC_U8_ZLIB, CODEC_PACKED_ZLIB):
-        base = h * w if codec == CODEC_U8_ZLIB else h * wp * 4
+    elif codec == CODEC_F32:
+        world = buf.view(np.dtype("<f4")).reshape(h, w)
+    elif codec in (CODEC_U8_ZLIB, CODEC_PACKED_ZLIB, CODEC_F32_ZLIB):
+        base = {CODEC_U8_ZLIB: h * w,
+                CODEC_PACKED_ZLIB: h * wp * 4,
+                CODEC_F32_ZLIB: h * w * 4}[codec]
         de = zlib.decompressobj()
         try:
             raw = de.decompress(buf, base)
@@ -730,6 +773,8 @@ def _recv_frame(sock: socket.socket, codec: str, meta: dict, h: int,
                 f"zlib payload decodes to {len(raw)} bytes, want {base}")
         if codec == CODEC_U8_ZLIB:
             world = np.frombuffer(raw, np.uint8).reshape(h, w).copy()
+        elif codec == CODEC_F32_ZLIB:
+            world = np.frombuffer(raw, "<f4").reshape(h, w).copy()
         else:
             world = _decode_packed(np.frombuffer(raw, np.uint8), h, w)
     else:  # xrle
@@ -870,6 +915,8 @@ def payload_nbytes(header: dict) -> int:
         CODEC_U8_ZLIB: (1, h * w - 1),
         CODEC_PACKED_ZLIB: (1, h * wp * 4 - 1),
         CODEC_XRLE: (0, h * w - 1),
+        CODEC_F32: (h * w * 4, h * w * 4),
+        CODEC_F32_ZLIB: (1, h * w * 4 - 1),
     }[codec]
     if not lo <= nbytes <= hi:
         raise WireProtocolError(

@@ -1,0 +1,214 @@
+"""Conv/FFT kernel tier (gol_tpu/ops/conv.py, PR 20).
+
+Covers the two large-radius tiers against the independent numpy
+oracles — bit-identically, across radii, neighborhood kinds, and
+non-power-of-two board shapes (the FFT leg must be exact on awkward
+transform lengths, not just 2^n) — the cached-spectrum reuse contract
+(witnessed by the PR-4 step-signature counter: stepping the same
+config twice must not mint a new signature), and the `select_tier`
+policy surface (env forcing, warn-fallback, dtype awareness, the
+crossover override).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from gol_tpu.models.largerthanlife import (  # noqa: E402
+    BOSCO,
+    CONWAY_LTL,
+    MAJORITY_R4,
+    LargerThanLifeRule,
+    run_turns_np,
+)
+from gol_tpu.obs import devstats  # noqa: E402
+from gol_tpu.ops import conv as C  # noqa: E402
+
+RNG = np.random.default_rng(1)
+
+
+# ------------------------------------------------------------- kernels
+
+
+def test_neighborhood_kernel_tap_counts():
+    # Moore box r=2: 5x5 minus center; von Neumann diamond: |dy|+|dx|
+    # <= r; circular: dy^2+dx^2 <= r^2 — counted independently here.
+    assert C.neighborhood_kernel(2, "M").sum() == 24
+    assert C.neighborhood_kernel(2, "M", middle=True).sum() == 25
+    assert C.neighborhood_kernel(2, "N").sum() == 12
+    assert C.neighborhood_kernel(2, "N", middle=True).sum() == 13
+    assert C.neighborhood_kernel(2, "C").sum() == 12
+    with pytest.raises(ValueError):
+        C.neighborhood_kernel(0)
+    with pytest.raises(ValueError):
+        C.neighborhood_kernel(2, "X")
+
+
+def test_kernel_wider_than_torus_refused():
+    k = C.neighborhood_kernel(8, "M")
+    with pytest.raises(ValueError):
+        C._embed_kernel(k, 16, 64)  # 17-wide kernel on 16 rows
+
+
+def test_oracles_agree_box_vs_taps():
+    # Two independent oracle mechanisms (summed-area table vs roll-tap
+    # accumulation) must agree before either is trusted as a reference.
+    b = (RNG.random((40, 56)) < 0.4).astype(np.uint8)
+    for r in (1, 3, 7):
+        for middle in (False, True):
+            kern = C.neighborhood_kernel(r, "M", middle)
+            assert np.array_equal(
+                C.box_counts_np(b, r, middle),
+                np.rint(C.counts_np(b, kern)).astype(np.int64))
+
+
+# ----------------------------------------------- tier parity vs oracle
+
+
+@pytest.mark.parametrize("shape", [(96, 80), (50, 70), (63, 49)])
+def test_conv_fft_counts_bit_exact_nonpow2(shape):
+    h, w = shape
+    b = (RNG.random((h, w)) < 0.35).astype(np.uint8)
+    for r in (1, 2, 3, 5, 8):
+        for kind in ("M", "N", "C"):
+            for middle in (False, True):
+                key = ("ltl", r, kind, middle)
+                kern = C.kernel_from_key(key)
+                want = np.rint(C.counts_np(b, kern)).astype(np.int64)
+                for fn in (C.conv_neighbor_sum, C.fft_neighbor_sum):
+                    got = np.rint(np.asarray(
+                        fn(jnp.asarray(b, dtype=jnp.float32),
+                           key))).astype(np.int64)
+                    assert np.array_equal(got, want), (
+                        f"{fn.__name__} {key} on {shape}")
+
+
+def test_fft_exact_under_heavy_dc():
+    # Worst case for the mean-split: a nearly-full board maximizes the
+    # DC term the split exists to remove. Counts must still be exact.
+    b = np.ones((128, 96), dtype=np.uint8)
+    b[RNG.integers(0, 128, 200), RNG.integers(0, 96, 200)] = 0
+    key = ("ltl", 8, "M", False)
+    want = C.box_counts_np(b, 8)
+    got = np.rint(np.asarray(C.fft_neighbor_sum(
+        jnp.asarray(b, dtype=jnp.float32), key))).astype(np.int64)
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("rule", [CONWAY_LTL, BOSCO, MAJORITY_R4],
+                         ids=lambda r: r.rulestring)
+def test_run_turns_bit_exact_vs_oracle(rule):
+    b = (RNG.random((64, 96)) < 0.35).astype(np.uint8)
+    turns = 4
+    want = np.asarray(run_turns_np(b, turns, rule), dtype=np.uint8)
+    for tier in ("conv", "fft"):
+        got = np.asarray(C.run_turns(jnp.asarray(b), turns, rule,
+                                     tier=tier), dtype=np.uint8)
+        assert np.array_equal(got, want), (tier, rule.rulestring)
+
+
+def test_bench_rule_family_reproduces_bosco():
+    # The bench's radius-scaled sweep rule is Bosco's fractions; at
+    # r=5 it must BE Bosco, or the sweep isn't testing what it claims.
+    bench = pytest.importorskip("bench")
+    assert bench._conv_rule(5).rulestring == BOSCO.rulestring
+    assert bench._conv_rule(1).rulestring == CONWAY_LTL.rulestring
+
+
+# --------------------------------------------- cached-spectrum reuse
+
+
+def test_second_step_mints_no_new_signature():
+    rule = LargerThanLifeRule("R3,C0,M1,S15..25,B16..20,NM")
+    b = jnp.asarray((RNG.random((60, 44)) < 0.35).astype(np.uint8))
+
+    np.asarray(C.run_turns(b, 2, rule, tier="fft"))  # populate caches
+    sigs = devstats.signature_count()
+    info0 = C._fft_spectrum_np.cache_info()
+
+    # An identical call is absorbed by the jit cache whole: no new
+    # step signature and no re-entry into the spectrum computation.
+    np.asarray(C.run_turns(b, 2, rule, tier="fft"))
+    assert devstats.signature_count() == sigs, \
+        "same (tier, shape, dtype, rule) must not re-sign/recompile"
+    assert C._fft_spectrum_np.cache_info().misses == info0.misses
+
+    # A different turn count retraces the outer scan, but the inner
+    # jitted fft program — and with it its baked-in spectrum — is
+    # reused: still no recompute, and turns is not signature state.
+    np.asarray(C.run_turns(b, 3, rule, tier="fft"))
+    info1 = C._fft_spectrum_np.cache_info()
+    assert info1.misses == info0.misses
+    assert devstats.signature_count() == sigs
+
+    # The host spectrum itself is lru-served: same key, same object.
+    s1 = C._fft_spectrum_np(60, 44, rule.kernel_key)
+    assert s1 is C._fft_spectrum_np(60, 44, rule.kernel_key), \
+        "kernel spectrum must be served from the lru cache"
+    info1 = C._fft_spectrum_np.cache_info()
+    assert info1.hits >= info0.hits + 2
+    assert info1.misses == info0.misses
+
+    # A different shape is a new program AND a new spectrum.
+    b2 = jnp.asarray((RNG.random((52, 44)) < 0.35).astype(np.uint8))
+    np.asarray(C.run_turns(b2, 2, rule, tier="fft"))
+    assert devstats.signature_count() == sigs + 1
+    assert C._fft_spectrum_np.cache_info().misses == info1.misses + 1
+
+
+# ------------------------------------------------------- tier policy
+
+
+def test_select_tier_binary_defaults(monkeypatch):
+    monkeypatch.delenv(C.TIER_ENV, raising=False)
+    monkeypatch.delenv(C.CROSSOVER_ENV, raising=False)
+    monkeypatch.delenv("GOL_FUSE_K", raising=False)
+    # radius-1 binary boards stay on the packed tiers
+    assert C.select_tier(4096, 4096, 1, "uint8") == "bitplane"
+    monkeypatch.setenv("GOL_FUSE_K", "8")
+    assert C.select_tier(4096, 4096, 1, "uint8") == "fused"
+    monkeypatch.delenv("GOL_FUSE_K")
+    # mid radii direct conv, large radii FFT (measured table)
+    assert C.select_tier(4096, 4096, 8, "uint8") == "conv"
+    assert C.select_tier(4096, 4096, 32, "uint8") == "fft"
+
+
+def test_select_tier_float_boards_never_bitplane(monkeypatch):
+    monkeypatch.delenv(C.TIER_ENV, raising=False)
+    monkeypatch.delenv(C.CROSSOVER_ENV, raising=False)
+    # Dense smooth kernels have no separable conv path: fft across the
+    # board, even at small radii where a box kernel would pick conv.
+    for r in (2, 4, 13, 64):
+        assert C.select_tier(1024, 1024, r, "float32") == "fft"
+    assert C.select_tier(
+        1024, 1024, 4, "float32", allowed=("conv",)) == "conv"
+
+
+def test_select_tier_forced_and_fallback(monkeypatch):
+    monkeypatch.setenv(C.TIER_ENV, "fft")
+    assert C.select_tier(64, 64, 1, "uint8") == "fft"
+    monkeypatch.setenv(C.TIER_ENV, "bitplane")
+    # forced tier the caller can't run falls through to auto, loudly
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got = C.select_tier(1024, 1024, 13, "float32",
+                            allowed=("conv", "fft"))
+    assert got == "fft"
+    assert any("GOL_KERNEL_TIER" in str(w.message) for w in caught)
+    monkeypatch.setenv(C.TIER_ENV, "warp")
+    with pytest.raises(ValueError):
+        C.select_tier(64, 64, 1, "uint8")
+
+
+def test_select_tier_crossover_override(monkeypatch):
+    monkeypatch.delenv(C.TIER_ENV, raising=False)
+    monkeypatch.setenv(C.CROSSOVER_ENV, "3")
+    assert C.select_tier(4096, 4096, 3, "uint8") == "fft"
+    assert C.select_tier(4096, 4096, 2, "uint8") == "conv"
+    monkeypatch.setenv(C.CROSSOVER_ENV, "not-a-number")
+    # garbage override falls back to the measured table
+    assert C.select_tier(4096, 4096, 8, "uint8") == "conv"

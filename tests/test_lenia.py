@@ -1,0 +1,263 @@
+"""Lenia — continuous-board family (gol_tpu/models/lenia.py, PR 20).
+
+Covers rulestring canonicalisation, kernel normalisation, jax-step
+parity against the independent float64 numpy oracle on both kernel
+tiers, the pinned-seed digest contract (the ORACLE digest is pinned;
+the float32 engine is tied to the oracle by tolerance — digest
+equality between float32 and float64 pipelines would be flaky by
+construction), the engine's f32 representation end-to-end (lossless
+wire frame, u8 fallback, non-diffable frames, checkpoint round-trip),
+and the nodiff client-error mapping.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from gol_tpu import wire  # noqa: E402
+from gol_tpu.client import FramesNotDiffable, _check_resp  # noqa: E402
+from gol_tpu.engine import Engine  # noqa: E402
+from gol_tpu.models import lenia as L  # noqa: E402
+from gol_tpu.ops import conv as C  # noqa: E402
+from gol_tpu.params import Params  # noqa: E402
+
+# Pinned-seed contract: seed_board(96, 96, seed=7) advanced 4 turns by
+# the float64 numpy oracle. Breaking this digest means the seed, the
+# kernel, or the growth math changed — all rulestring-visible state.
+PINNED_SEED = 7
+PINNED_TURNS = 4
+PINNED_DIGEST = \
+    "19d6af2d81c994c3ffdedeb038c78c376484086ded98a43cd94c9fdc52946ee4"
+
+
+# ----------------------------------------------------------- rule/kernel
+
+
+def test_rulestring_canonicalises():
+    a = L.LeniaRule("lenia:r=13,mu=0.150,sigma=0.015,dt=0.10")
+    assert a.rulestring == L.ORBIUM.rulestring
+    assert a == L.ORBIUM  # frozen dataclass on the canonical string
+    assert (a.radius, a.mu, a.sigma, a.dt) == (13, 0.15, 0.015, 0.1)
+
+
+@pytest.mark.parametrize("bad", [
+    "lenia:r=1,mu=0.15,sigma=0.015,dt=0.1",    # radius below 2
+    "lenia:r=13,mu=1.5,sigma=0.015,dt=0.1",    # mu out of (0,1)
+    "lenia:r=13,mu=0.15,sigma=0.0,dt=0.1",     # sigma out of (0,1)
+    "lenia:r=13,mu=0.15,sigma=0.015,dt=0.0",   # dt out of (0,1]
+    "R5,C0,M1,S33..57,B34..45,NM",             # not a Lenia string
+])
+def test_rulestring_rejects(bad):
+    with pytest.raises(ValueError):
+        L.LeniaRule(bad)
+
+
+def test_kernel_normalised_symmetric_hollow():
+    k = L.lenia_kernel_from_key(("lenia", 13))
+    assert k.shape == (27, 27)
+    assert abs(float(k.sum()) - 1.0) < 1e-6
+    assert k[13, 13] == 0.0  # shell kernel: zero at the center
+    assert np.allclose(k, k[::-1, ::-1])  # point symmetry
+
+
+# -------------------------------------------------- step parity/digest
+
+
+def test_step_matches_oracle_both_tiers():
+    rule = L.ORBIUM
+    s = L.seed_board(64, 64, 3, rule)
+    want = L.step_np(s, rule)
+    for tier in ("conv", "fft"):
+        got = np.asarray(L.lenia_step(jnp.asarray(s), rule, tier))
+        assert float(np.max(np.abs(
+            got.astype(np.float64) - want.astype(np.float64)))) < 1e-5
+
+
+def test_pinned_seed_oracle_digest():
+    s = L.seed_board(96, 96, PINNED_SEED, L.ORBIUM)
+    # seeding is deterministic and seed-sensitive
+    assert np.array_equal(s, L.seed_board(96, 96, PINNED_SEED, L.ORBIUM))
+    assert not np.array_equal(s, L.seed_board(96, 96, 8, L.ORBIUM))
+    for _ in range(PINNED_TURNS):
+        s = L.step_np(s, L.ORBIUM)
+    assert L.board_digest(s) == PINNED_DIGEST
+
+
+def test_engine_tracks_oracle_within_tolerance():
+    # The multi-turn float32 engine path vs the float64 oracle: errors
+    # accumulate per turn but must stay far inside the digest
+    # quantum. Dynamics must also be alive (the seed is z-centred on
+    # the growth bell exactly so this gate means something).
+    rule = L.ORBIUM
+    s0 = L.seed_board(96, 96, PINNED_SEED, rule)
+    ref = s0
+    for _ in range(PINNED_TURNS):
+        ref = L.step_np(ref, rule)
+    got = np.asarray(C.run_turns(jnp.asarray(s0), PINNED_TURNS, rule))
+    assert float(np.max(np.abs(
+        got.astype(np.float64) - ref.astype(np.float64)))) < 1e-4
+    a0, a1 = L.alive_count_np(s0), L.alive_count_np(ref)
+    assert a1 > 0 and a0 != a1, "dynamics degenerated to a fixpoint"
+
+
+def test_board_digest_folds_negative_zero():
+    a = np.array([[0.0, 0.2004]], dtype=np.float32)
+    b = np.array([[-0.0, 0.2001]], dtype=np.float32)
+    assert L.board_digest(a) == L.board_digest(b)  # same at 3 decimals
+    assert L.board_digest(a) != L.board_digest(a + 0.001)
+
+
+# ------------------------------------------------------ wire f32 frames
+
+
+def _frame_roundtrip(frame):
+    a, b = socket.socketpair()
+    a.settimeout(10)
+    b.settimeout(10)
+    try:
+        out = {}
+
+        def rx():
+            out["resp"] = wire.recv_msg(b)
+
+        t = threading.Thread(target=rx)
+        t.start()
+        wire.send_msg(a, {"ok": True}, frame=frame)
+        t.join(10)
+        assert "resp" in out
+        return out["resp"]
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.parametrize("caps", [
+    frozenset({wire.CAP_F32}),
+    frozenset({wire.CAP_F32, wire.CAP_ZLIB}),
+], ids=["f32", "f32+zlib"])
+def test_f32_frame_roundtrip_lossless(caps):
+    state = L.seed_board(50, 70, 5, L.ORBIUM)  # non-pow2 on purpose
+    _, got = _frame_roundtrip(wire.encode_board_f32(state, caps))
+    assert got.dtype == np.float32
+    assert np.array_equal(got, state)  # bit-exact, not approx
+
+
+def test_f32_frame_requires_capability():
+    state = L.seed_board(8, 8, 0, L.ORBIUM)
+    with pytest.raises(ValueError):
+        wire.encode_board_f32(state, frozenset())
+
+
+# ------------------------------------------------- engine f32 end-to-end
+
+
+def _run_engine(rule, world, w, h, turns):
+    eng = Engine(rule=rule)
+    p = Params(threads=1, image_width=w, image_height=h, turns=turns)
+    eng.server_distributor(p, world)
+    return eng
+
+
+def test_engine_f32_frame_and_u8_fallback():
+    rule = L.ORBIUM
+    s0 = L.seed_board(64, 64, PINNED_SEED, rule)
+    ref = s0
+    for _ in range(3):
+        ref = L.step_np(ref, rule)
+    eng = _run_engine(rule, s0, 64, 64, 3)
+    assert eng.frames_diffable is False
+    assert eng.binary_pixels is False
+
+    frame, turn = eng.get_world_frame(frozenset({wire.CAP_F32}))
+    _, got = _frame_roundtrip(frame)
+    assert turn == 3
+    assert got.dtype == np.float32
+    assert float(np.max(np.abs(
+        got.astype(np.float64) - ref.astype(np.float64)))) < 1e-4
+
+    # Caps-less peer: quantized u8 pixels of the same state.
+    frame, _ = eng.get_world_frame(frozenset())
+    _, px = _frame_roundtrip(frame)
+    assert px.dtype == np.uint8
+    want = np.rint(got * 255.0).astype(np.uint8)
+    assert np.array_equal(px, want)
+
+
+def test_engine_float_checkpoint_roundtrip(tmp_path):
+    rule = L.ORBIUM
+    s0 = L.seed_board(64, 64, PINNED_SEED, rule)
+    eng = _run_engine(rule, s0, 64, 64, 2)
+    path = str(tmp_path / "lenia.ckpt")
+    eng.save_checkpoint(path)
+
+    frame, _ = eng.get_world_frame(frozenset({wire.CAP_F32}))
+    _, before = _frame_roundtrip(frame)
+
+    eng2 = Engine(rule=rule)
+    assert eng2.load_checkpoint(path) == 2
+    frame, turn = eng2.get_world_frame(frozenset({wire.CAP_F32}))
+    _, after = _frame_roundtrip(frame)
+    assert turn == 2
+    assert np.array_equal(before, after)  # restore is BIT-exact
+
+    # ...and the restored engine keeps evolving correctly.
+    ref = before
+    for _ in range(2):
+        ref = L.step_np(ref, rule)
+    eng2.server_distributor(
+        Params(threads=1, image_width=64, image_height=64, turns=2),
+        before)
+    frame, _ = eng2.get_world_frame(frozenset({wire.CAP_F32}))
+    _, got = _frame_roundtrip(frame)
+    assert float(np.max(np.abs(
+        got.astype(np.float64) - ref.astype(np.float64)))) < 1e-4
+
+
+def test_binary_engine_refuses_float_checkpoint(tmp_path):
+    # A durable f32 manifest checkpoint restored onto a binary engine
+    # must refuse on the cell-dtype delta (tagged geometry error) —
+    # BEFORE any rule-string comparison, and even an explicit reshard
+    # cannot repack continuous state into bits.
+    from gol_tpu import ckpt
+    from gol_tpu.ckpt import GeometryMismatch
+    from gol_tpu.ckpt.restore import restore_engine
+
+    eng = Engine()  # binary B3/S23; run once so geometry() is real
+    rng = np.random.default_rng(0)
+    eng.server_distributor(
+        Params(threads=1, image_width=64, image_height=32, turns=1),
+        (rng.random((32, 64)) < 0.3).astype(np.uint8) * np.uint8(255))
+
+    state = L.seed_board(32, 32, 1, L.ORBIUM)
+    snap = ckpt.Snapshot(state, "f32", 0, 5, (32, 32),
+                         L.ORBIUM.rulestring,
+                         mesh={"devices": eng.geometry()["devices"]})
+    w = ckpt.CheckpointWriter(str(tmp_path), run_id="t", keep_last=3)
+    path = w.write_sync(snap)
+    with pytest.raises(GeometryMismatch) as ei:
+        restore_engine(eng, path)
+    assert "cell dtype" in str(ei.value)
+    assert getattr(ei.value, "rpc_error_kind") == "geometry"
+    with pytest.raises(ValueError):
+        restore_engine(eng, path, reshard=True)
+
+    # ...while the same manifest restores cleanly on a Lenia engine.
+    eng2 = _run_engine(L.ORBIUM, state, 32, 32, 1)
+    assert restore_engine(eng2, path) == 5
+
+
+# ------------------------------------------------------- nodiff mapping
+
+
+def test_nodiff_error_maps_to_frames_not_diffable():
+    with pytest.raises(FramesNotDiffable):
+        _check_resp({"ok": False,
+                     "error": "nodiff: re-poll without basis_turn"})
+    # untagged errors keep their generic mapping
+    with pytest.raises(RuntimeError):
+        _check_resp({"ok": False, "error": "something else"})

@@ -1,0 +1,173 @@
+"""conv-smoke: prove the conv/FFT kernel tier end to end, fast.
+
+Small boards only — parity and plumbing, NOT policy timing (tier
+choice at these sizes is dispatch-noise; `bench.py --conv` owns the
+gated crossover measurement at 4096²). Checks:
+
+  * conv and fft tiers are BIT-identical to the independent numpy
+    summed-area oracle for Larger-than-Life rules at r=1 (Conway's
+    B3/S23 as an LtL rule) and r=5 (Bosco's Rule), non-pow2 board;
+  * the Lenia float32 step tracks the float64 numpy oracle within
+    1e-4 max-abs over 4 turns, on BOTH tiers;
+  * a real Engine run (server_distributor) of each family lands on
+    the same oracle trajectory, and a Lenia engine serves a lossless
+    f32 frame to a CAP_F32 peer;
+  * `select_tier` policy surface: env forcing honored, float boards
+    never choose a packed tier, unknown names refused;
+  * `gol_conv_dispatches_total{tier=...}` / one-hot `gol_kernel_tier`
+    hold real samples in the registry after the runs.
+
+Exit 0 = pass.
+
+    make conv-smoke     # part of the `make smoke` chain
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from gol_tpu import wire
+    from gol_tpu.engine import Engine
+    from gol_tpu.models import lenia as lenia_mod
+    from gol_tpu.models.largerthanlife import BOSCO, CONWAY_LTL, \
+        run_turns_np
+    from gol_tpu.ops import conv as conv_ops
+    from gol_tpu.params import Params
+
+    problems = []
+
+    def check(ok, what):
+        print(f"conv-smoke: {'ok  ' if ok else 'FAIL'} {what}")
+        if not ok:
+            problems.append(what)
+
+    rng = np.random.default_rng(0)
+
+    # ---- LtL parity: both tiers vs the numpy oracle --------------------
+    b = (rng.random((96, 80)) < 0.35).astype(np.uint8)
+    for rule, turns in ((CONWAY_LTL, 8), (BOSCO, 4)):
+        want = np.asarray(run_turns_np(b, turns, rule), dtype=np.uint8)
+        for tier in ("conv", "fft"):
+            got = np.asarray(conv_ops.run_turns(
+                jnp.asarray(b), turns, rule, tier=tier), dtype=np.uint8)
+            check(np.array_equal(got, want),
+                  f"{tier} bit-identical vs oracle "
+                  f"({rule.rulestring}, {turns} turns, 96x80)")
+
+    # ---- Lenia parity: float32 jax vs float64 numpy --------------------
+    rule = lenia_mod.ORBIUM
+    s0 = lenia_mod.seed_board(96, 96, 7, rule)
+    ref = s0
+    for _ in range(4):
+        ref = lenia_mod.step_np(ref, rule)
+    for tier in ("conv", "fft"):
+        got = np.asarray(conv_ops.run_turns(
+            jnp.asarray(s0), 4, rule, tier=tier))
+        err = float(np.max(np.abs(got.astype(np.float64)
+                                  - ref.astype(np.float64))))
+        check(err < 1e-4,
+              f"lenia {tier} max-abs {err:.2e} < 1e-4 vs float64 "
+              f"oracle (4 turns, 96x96)")
+
+    # ---- Engine end to end ---------------------------------------------
+    eng = Engine(rule=BOSCO)
+    p = Params(threads=1, image_width=80, image_height=96, turns=4)
+    out, turn = eng.server_distributor(p, b * np.uint8(255))
+    want = np.asarray(run_turns_np(b, 4, BOSCO), dtype=np.uint8)
+    check(turn == 4 and np.array_equal(
+        (np.asarray(out) != 0).astype(np.uint8), want),
+        "Engine(BOSCO) trajectory bit-identical vs oracle")
+
+    eng = Engine(rule=rule)
+    p = Params(threads=1, image_width=96, image_height=96, turns=4)
+    out, turn = eng.server_distributor(p, s0)
+    frame, fturn = eng.get_world_frame(frozenset({wire.CAP_F32}))
+    # Round-trip the frame through the real wire codec path.
+    import socket
+    import threading
+
+    a, bsock = socket.socketpair()
+    a.settimeout(10)
+    bsock.settimeout(10)
+    box = {}
+    t = threading.Thread(
+        target=lambda: box.update(resp=wire.recv_msg(bsock)))
+    t.start()
+    wire.send_msg(a, {"ok": True}, frame=frame)
+    t.join(10)
+    a.close()
+    bsock.close()
+    _, got = box["resp"]
+    err = float(np.max(np.abs(got.astype(np.float64)
+                              - ref.astype(np.float64))))
+    check(turn == 4 and fturn == 4 and err < 1e-4,
+          f"Engine(ORBIUM) f32 frame max-abs {err:.2e} < 1e-4 vs "
+          f"oracle")
+    check(eng.frames_diffable is False,
+          "float boards refuse frame diffing (frames_diffable)")
+
+    # ---- policy surface ------------------------------------------------
+    saved = os.environ.pop(conv_ops.TIER_ENV, None)
+    try:
+        check(conv_ops.select_tier(4096, 4096, 1, "uint8")
+              in ("bitplane", "fused"),
+              "r=1 binary stays on a packed tier")
+        check(conv_ops.select_tier(1024, 1024, 13, "float32") == "fft",
+              "float boards auto-select fft")
+        os.environ[conv_ops.TIER_ENV] = "fft"
+        check(conv_ops.select_tier(64, 64, 1, "uint8") == "fft",
+              f"{conv_ops.TIER_ENV}=fft forces the tier")
+        os.environ[conv_ops.TIER_ENV] = "warp"
+        try:
+            conv_ops.select_tier(64, 64, 1, "uint8")
+            check(False, "unknown tier name refused")
+        except ValueError:
+            check(True, "unknown tier name refused")
+    finally:
+        if saved is None:
+            os.environ.pop(conv_ops.TIER_ENV, None)
+        else:
+            os.environ[conv_ops.TIER_ENV] = saved
+
+    # ---- registry families ---------------------------------------------
+    from gol_tpu.obs.metrics import REGISTRY
+
+    samples = {}
+    for line in REGISTRY.render_prometheus().splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            samples[key] = float(val)
+        except ValueError:
+            pass
+    for tier in ("conv", "fft"):
+        key = f'gol_conv_dispatches_total{{tier="{tier}"}}'
+        check(samples.get(key, 0) > 0,
+              f"registry sample populated: {key}")
+    onehot = sum(samples.get(f'gol_kernel_tier{{tier="{t}"}}', 0.0)
+                 for t in conv_ops.TIERS)
+    check(onehot == 1.0,
+          f"gol_kernel_tier is one-hot (sum={onehot})")
+
+    if problems:
+        print(f"conv-smoke: {len(problems)} problem(s)")
+        return 1
+    print("conv-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,7 +70,8 @@ DEFAULT_GATE_PATTERN = (
     r"|encode_calls_per_published_frame|viewer_fanout_p\d+_ms"
     r"|telemetry_overhead_pct|heartbeat_payload_p\d+_bytes"
     r"|alert_detection_p\d+_ms|journal_overhead_pct"
-    r"|usage_overhead_pct|usage_attribution_error_pct")
+    r"|usage_overhead_pct|usage_attribution_error_pct"
+    r"|conv_autoselect_win_pct")
 DEFAULT_CHANGES_PATH = "CHANGES.md"
 
 
